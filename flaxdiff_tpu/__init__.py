@@ -1,0 +1,11 @@
+"""flaxdiff_tpu — a TPU-native diffusion-model framework.
+
+A from-scratch JAX/XLA/Pallas framework with capability parity to
+AshishKumar4/FlaxDiff, designed TPU-first: functional scheduler/predictor
+math, a single lax.scan sampler engine, NamedSharding FSDP + sequence
+parallelism over device meshes, and first-party Pallas kernels.
+"""
+
+__version__ = "0.1.0"
+
+from . import predictors, schedulers, typing, utils

@@ -1,0 +1,156 @@
+"""Prediction transforms: what the network predicts and how to invert it.
+
+Capability parity with reference flaxdiff/predictors/__init__.py:9-95
+(DiffusionPredictionTransform, Epsilon/Direct/V/Karras transforms),
+redesigned as stateless flax.struct pytrees. The contract:
+
+  forward(schedule, x0, noise, t)   -> (x_t, target)       [training]
+  transform_output(x_t, t, raw, s)  -> prediction in target space
+  input_scale(schedule, t)          -> c_in multiplier on x_t before the net
+  to_x0_eps(x_t, t, pred, s)        -> (x0_hat, eps_hat)   [sampling]
+
+All math is per-sample-broadcast via bcast_right and safe under jit/scan.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from ..schedulers.common import NoiseSchedule, SigmaSchedule, bcast_right
+
+
+class PredictionTransform(flax.struct.PyTreeNode):
+    """Base: identity output transform, unit input scale."""
+
+    def forward(self, schedule: NoiseSchedule, x0: jax.Array, noise: jax.Array,
+                t: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x_t = schedule.add_noise(x0, noise, t)
+        return x_t, self.target(schedule, x0, noise, x_t, t)
+
+    def target(self, schedule, x0, noise, x_t, t) -> jax.Array:
+        raise NotImplementedError
+
+    def input_scale(self, schedule: NoiseSchedule, t: jax.Array) -> jax.Array:
+        return jnp.ones_like(t, dtype=jnp.float32)
+
+    def transform_output(self, x_t: jax.Array, t: jax.Array, raw: jax.Array,
+                         schedule: NoiseSchedule) -> jax.Array:
+        """Map raw network output into target space (identity by default)."""
+        return raw
+
+    def to_x0_eps(self, x_t: jax.Array, t: jax.Array, pred: jax.Array,
+                  schedule: NoiseSchedule) -> Tuple[jax.Array, jax.Array]:
+        raise NotImplementedError
+
+
+class EpsilonPredictionTransform(PredictionTransform):
+    """Network predicts the noise eps (reference predictors/__init__.py:35-45)."""
+
+    def target(self, schedule, x0, noise, x_t, t) -> jax.Array:
+        return noise
+
+    def to_x0_eps(self, x_t, t, pred, schedule):
+        signal, sigma = schedule.rates(t)
+        signal = bcast_right(signal, x_t.ndim)
+        sigma = bcast_right(sigma, x_t.ndim)
+        x0 = (x_t - sigma * pred) / jnp.maximum(signal, 1e-12)
+        return x0, pred
+
+
+class DirectPredictionTransform(PredictionTransform):
+    """Network predicts x0 directly (reference 46-53)."""
+
+    def target(self, schedule, x0, noise, x_t, t) -> jax.Array:
+        return x0
+
+    def to_x0_eps(self, x_t, t, pred, schedule):
+        signal, sigma = schedule.rates(t)
+        signal = bcast_right(signal, x_t.ndim)
+        sigma = bcast_right(sigma, x_t.ndim)
+        eps = (x_t - signal * pred) / jnp.maximum(sigma, 1e-12)
+        return pred, eps
+
+
+class VPredictionTransform(PredictionTransform):
+    """v = signal * eps - noise_rate * x0 (Salimans & Ho; reference 54-72).
+
+    Inversion assumes a VP schedule (signal^2 + sigma^2 = 1); division by
+    (signal^2 + sigma^2) keeps it exact for near-VP schedules too.
+    """
+
+    def target(self, schedule, x0, noise, x_t, t) -> jax.Array:
+        signal, sigma = schedule.rates(t)
+        signal = bcast_right(signal, x0.ndim)
+        sigma = bcast_right(sigma, x0.ndim)
+        return signal * noise - sigma * x0
+
+    def to_x0_eps(self, x_t, t, pred, schedule):
+        signal, sigma = schedule.rates(t)
+        signal = bcast_right(signal, x_t.ndim)
+        sigma = bcast_right(sigma, x_t.ndim)
+        norm = signal ** 2 + sigma ** 2
+        x0 = (signal * x_t - sigma * pred) / norm
+        eps = (sigma * x_t + signal * pred) / norm
+        return x0, eps
+
+
+class KarrasPredictionTransform(PredictionTransform):
+    """EDM preconditioning (Karras et al. 2022; reference 73-95).
+
+    D(x; sigma) = c_skip * x + c_out * F(c_in * x; c_noise); the training
+    target is x0 and `transform_output` applies the c_skip/c_out wrap, so
+    weighted MSE on (D, x0) with the SigmaSchedule EDM weights reproduces
+    the EDM loss exactly.
+    """
+
+    sigma_data: float = flax.struct.field(pytree_node=False, default=0.5)
+
+    def _coeffs(self, schedule: SigmaSchedule, t: jax.Array):
+        sigma = schedule.sigmas(t)
+        sd2 = self.sigma_data ** 2
+        denom = sigma ** 2 + sd2
+        c_skip = sd2 / denom
+        c_out = sigma * self.sigma_data / jnp.sqrt(denom)
+        c_in = 1.0 / jnp.sqrt(denom)
+        return sigma, c_skip, c_out, c_in
+
+    def target(self, schedule, x0, noise, x_t, t) -> jax.Array:
+        return x0
+
+    def input_scale(self, schedule, t) -> jax.Array:
+        _, _, _, c_in = self._coeffs(schedule, t)
+        return c_in
+
+    def transform_output(self, x_t, t, raw, schedule) -> jax.Array:
+        _, c_skip, c_out, _ = self._coeffs(schedule, t)
+        c_skip = bcast_right(c_skip, x_t.ndim)
+        c_out = bcast_right(c_out, x_t.ndim)
+        return c_skip * x_t + c_out * raw
+
+    def to_x0_eps(self, x_t, t, pred, schedule):
+        # pred is already the denoised D(x; sigma) after transform_output.
+        sigma, _, _, _ = self._coeffs(schedule, t)
+        sigma = bcast_right(sigma, x_t.ndim)
+        eps = (x_t - pred) / jnp.maximum(sigma, 1e-12)
+        return pred, eps
+
+
+TRANSFORM_REGISTRY = {
+    "epsilon": EpsilonPredictionTransform,
+    "eps": EpsilonPredictionTransform,
+    "direct": DirectPredictionTransform,
+    "x0": DirectPredictionTransform,
+    "v": VPredictionTransform,
+    "v_prediction": VPredictionTransform,
+    "karras": KarrasPredictionTransform,
+    "edm": KarrasPredictionTransform,
+}
+
+
+def get_transform(name: str, **kwargs) -> PredictionTransform:
+    if name not in TRANSFORM_REGISTRY:
+        raise ValueError(f"Unknown prediction transform {name!r}")
+    return TRANSFORM_REGISTRY[name](**kwargs)

@@ -1,0 +1,119 @@
+"""Noise-schedule core: pure functional, jit/scan-native.
+
+Capability parity with reference flaxdiff/schedulers/common.py:18-101
+(NoiseScheduler / GeneralizedNoiseScheduler contracts), redesigned as
+flax.struct pytrees so a schedule can be closed over by `jax.jit`, carried
+through `lax.scan`, and donated/sharded like any other array tree. All
+methods are pure; timestep sampling takes an explicit PRNG key.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from ..typing import PRNGKey
+
+
+def bcast_right(v: jax.Array, ndim: int) -> jax.Array:
+    """Broadcast a per-sample vector [B] to [B, 1, ..., 1] with `ndim` dims.
+
+    Replaces reference `reshape_rates` (schedulers/common.py:10-15).
+    """
+    return v.reshape(v.shape + (1,) * (ndim - v.ndim))
+
+
+class NoiseSchedule(flax.struct.PyTreeNode):
+    """Base diffusion noise schedule.
+
+    The forward process is x_t = signal_rate(t) * x0 + noise_rate(t) * eps.
+    Discrete (VP) schedules use integer t in [0, timesteps); continuous
+    schedules use float t. Subclasses implement `rates`, `loss_weights`,
+    and `sample_timesteps`.
+    """
+
+    timesteps: int = flax.struct.field(pytree_node=False, default=1000)
+
+    # --- core contract -----------------------------------------------------
+    def rates(self, t: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """(signal_rate, noise_rate) per sample, shape == t.shape."""
+        raise NotImplementedError
+
+    def loss_weights(self, t: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def sample_timesteps(self, key: PRNGKey, n: int) -> jax.Array:
+        """Training-time timestep sampling (reference common.py:18-37)."""
+        raise NotImplementedError
+
+    # --- derived operations ------------------------------------------------
+    def add_noise(self, x0: jax.Array, noise: jax.Array, t: jax.Array) -> jax.Array:
+        signal, sigma = self.rates(t)
+        return bcast_right(signal, x0.ndim) * x0 + bcast_right(sigma, x0.ndim) * noise
+
+    def remove_all_noise(self, x_t: jax.Array, noise: jax.Array, t: jax.Array) -> jax.Array:
+        signal, sigma = self.rates(t)
+        return (x_t - bcast_right(sigma, x_t.ndim) * noise) / bcast_right(signal, x_t.ndim)
+
+    def transform_inputs(self, x: jax.Array, t: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """(model_input_x, model_input_t): conditioning-space transform.
+
+        Discrete schedules feed raw integer steps; sigma schedules override
+        to feed e.g. log(sigma)/4 (reference karras.py:26-31).
+        """
+        return x, t
+
+    def max_noise_std(self) -> jax.Array:
+        """Std-dev of x_T — used to scale initial sampling noise
+        (reference common.py `get_max_variance`)."""
+        signal, sigma = self.rates(jnp.asarray([self.timesteps - 1]))
+        return (sigma / jnp.maximum(signal, 1e-12))[0]
+
+    @property
+    def is_continuous(self) -> bool:
+        return False
+
+
+class SigmaSchedule(NoiseSchedule):
+    """Karras-style generalized schedule: signal_rate == 1, noise level sigma.
+
+    Parity with reference GeneralizedNoiseScheduler (schedulers/common.py:
+    68-101): adds the sigma(t) parameterization and its inverse t(sigma).
+    """
+
+    sigma_min: float = flax.struct.field(pytree_node=False, default=0.002)
+    sigma_max: float = flax.struct.field(pytree_node=False, default=80.0)
+    sigma_data: float = flax.struct.field(pytree_node=False, default=0.5)
+
+    def sigmas(self, t: jax.Array) -> jax.Array:
+        """Noise level as a function of a [0, timesteps) step index."""
+        raise NotImplementedError
+
+    def timesteps_from_sigmas(self, sigma: jax.Array) -> jax.Array:
+        """Inverse of `sigmas` (reference karras.py:33-45); needed by RK4."""
+        raise NotImplementedError
+
+    def rates(self, t: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        sigma = self.sigmas(t)
+        return jnp.ones_like(sigma), sigma
+
+    def loss_weights(self, t: jax.Array) -> jax.Array:
+        """EDM weight (sigma^2 + sigma_d^2) / (sigma * sigma_d)^2
+        (reference karras.py:19-24, incl. the epsilon guard)."""
+        sigma = self.sigmas(t)
+        denom = jnp.maximum((sigma * self.sigma_data) ** 2, 1e-8)
+        return (sigma ** 2 + self.sigma_data ** 2) / denom
+
+    def transform_inputs(self, x: jax.Array, t: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        sigma = self.sigmas(t)
+        c_noise = 0.25 * jnp.log(jnp.maximum(sigma, 1e-12))
+        return x, c_noise
+
+    def max_noise_std(self) -> jax.Array:
+        return jnp.asarray(self.sigma_max)
+
+    @property
+    def is_continuous(self) -> bool:
+        return True

@@ -1,0 +1,60 @@
+"""Continuous VP schedules (closed-form rates, float t in [0, 1]).
+
+Parity with reference flaxdiff/schedulers/continuous.py + cosine.py
+(CosineContinuousNoiseScheduler at cosine.py:34-43) + sqrt.py.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..typing import PRNGKey
+from .common import NoiseSchedule
+
+
+class ContinuousNoiseSchedule(NoiseSchedule):
+    """Base for continuous schedules: t ~ U[0,1], timesteps kept for the
+    discrete-step driving convention of samplers (scaled internally)."""
+
+    def sample_timesteps(self, key: PRNGKey, n: int) -> jax.Array:
+        return jax.random.uniform(key, (n,))
+
+    def _normalize(self, t: jax.Array) -> jax.Array:
+        # Samplers drive schedules in a [0, timesteps) domain
+        # (reference samplers/common.py:181-184 scale_steps); accept both.
+        t = t.astype(jnp.float32)
+        return jnp.where(t > 1.0, t / self.timesteps, t)
+
+    @property
+    def is_continuous(self) -> bool:
+        return True
+
+
+class CosineContinuousNoiseSchedule(ContinuousNoiseSchedule):
+    """signal = cos(pi/2 * t), noise = sin(pi/2 * t)."""
+
+    def rates(self, t: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        u = self._normalize(t)
+        angle = 0.5 * jnp.pi * u
+        return jnp.cos(angle), jnp.sin(angle)
+
+    def loss_weights(self, t: jax.Array) -> jax.Array:
+        return jnp.ones_like(self._normalize(t))
+
+    def max_noise_std(self) -> jax.Array:
+        signal, sigma = self.rates(jnp.asarray([1.0 - 1.0 / self.timesteps]))
+        return (sigma / jnp.maximum(signal, 1e-12))[0]
+
+
+class SqrtContinuousNoiseSchedule(ContinuousNoiseSchedule):
+    """alpha_bar = 1 - sqrt(t + s) (Li et al. Diffusion-LM; reference sqrt.py)."""
+
+    def rates(self, t: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        u = self._normalize(t)
+        alpha_bar = jnp.clip(1.0 - jnp.sqrt(u + 1e-4), 1e-6, 1.0)
+        return jnp.sqrt(alpha_bar), jnp.sqrt(1.0 - alpha_bar)
+
+    def loss_weights(self, t: jax.Array) -> jax.Array:
+        return jnp.ones_like(self._normalize(t))

@@ -1,0 +1,131 @@
+"""Discrete variance-preserving (beta) schedules with precomputed tables.
+
+Parity with reference flaxdiff/schedulers/discrete.py (DiscreteNoiseScheduler,
+tables at 19-40, P2 weights 46-52, posterior 60-71) plus the beta-schedule
+family (linear.py, cosine.py, exp.py). Tables are jnp arrays living on device
+as pytree leaves — rate lookups are gathers inside the compiled step, never
+host-side indexing.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..typing import PRNGKey
+from .common import NoiseSchedule
+
+
+def linear_beta_schedule(timesteps: int, beta_start: float = 0.0001,
+                         beta_end: float = 0.02) -> np.ndarray:
+    """Linear betas with the canonical 1000/T rescale (reference linear.py:4-9)."""
+    scale = 1000.0 / timesteps
+    return np.linspace(scale * beta_start, scale * beta_end, timesteps,
+                       dtype=np.float64)
+
+
+def cosine_beta_schedule(timesteps: int, s: float = 0.008,
+                         max_beta: float = 0.999) -> np.ndarray:
+    """Nichol & Dhariwal cosine alpha-bar -> betas (reference cosine.py:8-13)."""
+    steps = np.arange(timesteps + 1, dtype=np.float64) / timesteps
+    alpha_bar = np.cos((steps + s) / (1 + s) * np.pi / 2) ** 2
+    betas = 1.0 - alpha_bar[1:] / alpha_bar[:-1]
+    return np.clip(betas, 0.0, max_beta)
+
+
+def exp_beta_schedule(timesteps: int, beta_start: float = 0.0001,
+                      beta_end: float = 0.02) -> np.ndarray:
+    """Geometric (exponential) beta ramp (reference exp.py)."""
+    return np.exp(np.linspace(np.log(beta_start), np.log(beta_end), timesteps))
+
+
+class DiscreteNoiseSchedule(NoiseSchedule):
+    """VP schedule over precomputed alpha-bar tables.
+
+    signal_rate = sqrt(alpha_bar[t]), noise_rate = sqrt(1 - alpha_bar[t]).
+    """
+
+    betas: jax.Array = None
+    alphas_cumprod: jax.Array = None
+    sqrt_alphas_cumprod: jax.Array = None
+    sqrt_one_minus_alphas_cumprod: jax.Array = None
+    posterior_variance: jax.Array = None
+    posterior_log_variance_clipped: jax.Array = None
+    posterior_mean_coef1: jax.Array = None
+    posterior_mean_coef2: jax.Array = None
+    # P2 weighting (Choi et al. 2022): w = (k + SNR)^-gamma
+    p2_loss_weight_k: float = flax.struct.field(pytree_node=False, default=1.0)
+    p2_loss_weight_gamma: float = flax.struct.field(pytree_node=False, default=0.0)
+
+    @classmethod
+    def from_betas(cls, betas: np.ndarray, *, p2_k: float = 1.0,
+                   p2_gamma: float = 0.0) -> "DiscreteNoiseSchedule":
+        # The canonical 1000/T rescale produces beta >= 1 for tiny T; clamp to
+        # keep alpha-bar tables valid at any step count.
+        betas = np.clip(np.asarray(betas, dtype=np.float64), 1e-8, 0.999)
+        timesteps = len(betas)
+        alphas = 1.0 - betas
+        alphas_cumprod = np.cumprod(alphas)
+        alphas_cumprod_prev = np.append(1.0, alphas_cumprod[:-1])
+        posterior_variance = betas * (1.0 - alphas_cumprod_prev) / (1.0 - alphas_cumprod)
+        posterior_log_variance = np.log(
+            np.maximum(posterior_variance, posterior_variance[1] if timesteps > 1 else 1e-20))
+        coef1 = betas * np.sqrt(alphas_cumprod_prev) / (1.0 - alphas_cumprod)
+        coef2 = (1.0 - alphas_cumprod_prev) * np.sqrt(alphas) / (1.0 - alphas_cumprod)
+        f32 = lambda a: jnp.asarray(a, dtype=jnp.float32)
+        return cls(
+            timesteps=timesteps,
+            betas=f32(betas),
+            alphas_cumprod=f32(alphas_cumprod),
+            sqrt_alphas_cumprod=f32(np.sqrt(alphas_cumprod)),
+            sqrt_one_minus_alphas_cumprod=f32(np.sqrt(1.0 - alphas_cumprod)),
+            posterior_variance=f32(posterior_variance),
+            posterior_log_variance_clipped=f32(posterior_log_variance),
+            posterior_mean_coef1=f32(coef1),
+            posterior_mean_coef2=f32(coef2),
+            p2_loss_weight_k=p2_k,
+            p2_loss_weight_gamma=p2_gamma,
+        )
+
+    # --- contract ---------------------------------------------------------
+    def rates(self, t: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        t = jnp.clip(t.astype(jnp.int32), 0, self.timesteps - 1)
+        return self.sqrt_alphas_cumprod[t], self.sqrt_one_minus_alphas_cumprod[t]
+
+    def loss_weights(self, t: jax.Array) -> jax.Array:
+        t = jnp.clip(t.astype(jnp.int32), 0, self.timesteps - 1)
+        snr = self.alphas_cumprod[t] / (1.0 - self.alphas_cumprod[t])
+        return (self.p2_loss_weight_k + snr) ** (-self.p2_loss_weight_gamma)
+
+    def sample_timesteps(self, key: PRNGKey, n: int) -> jax.Array:
+        return jax.random.randint(key, (n,), 0, self.timesteps)
+
+    # --- DDPM posterior q(x_{t-1} | x_t, x0) (reference discrete.py:60-71) --
+    def posterior_mean(self, x0: jax.Array, x_t: jax.Array, t: jax.Array) -> jax.Array:
+        t = jnp.clip(t.astype(jnp.int32), 0, self.timesteps - 1)
+        c1 = self.posterior_mean_coef1[t].reshape((-1,) + (1,) * (x0.ndim - 1))
+        c2 = self.posterior_mean_coef2[t].reshape((-1,) + (1,) * (x0.ndim - 1))
+        return c1 * x0 + c2 * x_t
+
+    def posterior_log_variance(self, t: jax.Array, ndim: int) -> jax.Array:
+        t = jnp.clip(t.astype(jnp.int32), 0, self.timesteps - 1)
+        return self.posterior_log_variance_clipped[t].reshape((-1,) + (1,) * (ndim - 1))
+
+
+def LinearNoiseSchedule(timesteps: int = 1000, beta_start: float = 0.0001,
+                        beta_end: float = 0.02, **kw) -> DiscreteNoiseSchedule:
+    return DiscreteNoiseSchedule.from_betas(
+        linear_beta_schedule(timesteps, beta_start, beta_end), **kw)
+
+
+def CosineNoiseSchedule(timesteps: int = 1000, s: float = 0.008, **kw) -> DiscreteNoiseSchedule:
+    return DiscreteNoiseSchedule.from_betas(cosine_beta_schedule(timesteps, s), **kw)
+
+
+def ExpNoiseSchedule(timesteps: int = 1000, beta_start: float = 0.0001,
+                     beta_end: float = 0.02, **kw) -> DiscreteNoiseSchedule:
+    return DiscreteNoiseSchedule.from_betas(
+        exp_beta_schedule(timesteps, beta_start, beta_end), **kw)

@@ -1,0 +1,105 @@
+"""Karras/EDM sigma-parameterized (VE) schedules.
+
+Parity with reference flaxdiff/schedulers/karras.py: KarrasVENoiseScheduler
+(rho-ramp 13-17, EDM weight 19-24, log-sigma input transform 26-31, inverse
+33-45), SimpleExpNoiseScheduler (52-62), EDMNoiseScheduler (64-76), and
+cosine.py:20-32 CosineGeneralNoiseScheduler.
+"""
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from ..typing import PRNGKey
+from .common import SigmaSchedule
+
+
+class KarrasVENoiseSchedule(SigmaSchedule):
+    """Karras et al. 2022 rho-spaced sigma ramp.
+
+    sigma(i) = (smax^(1/rho) + u * (smin^(1/rho) - smax^(1/rho)))^rho,
+    u = i / (timesteps - 1). i=0 is max noise, matching the samplers'
+    high-noise-first step convention.
+    """
+
+    rho: float = flax.struct.field(pytree_node=False, default=7.0)
+
+    def _u(self, t: jax.Array) -> jax.Array:
+        return jnp.clip(t.astype(jnp.float32) / max(self.timesteps - 1, 1), 0.0, 1.0)
+
+    def sigmas(self, t: jax.Array) -> jax.Array:
+        inv_rho = 1.0 / self.rho
+        lo, hi = self.sigma_min ** inv_rho, self.sigma_max ** inv_rho
+        return (hi + self._u(t) * (lo - hi)) ** self.rho
+
+    def timesteps_from_sigmas(self, sigma: jax.Array) -> jax.Array:
+        inv_rho = 1.0 / self.rho
+        lo, hi = self.sigma_min ** inv_rho, self.sigma_max ** inv_rho
+        u = (sigma ** inv_rho - hi) / (lo - hi)
+        return jnp.clip(u, 0.0, 1.0) * (self.timesteps - 1)
+
+    def sample_timesteps(self, key: PRNGKey, n: int) -> jax.Array:
+        return jax.random.uniform(key, (n,)) * (self.timesteps - 1)
+
+
+class SimpleExpNoiseSchedule(SigmaSchedule):
+    """Log-linear sigma ramp (reference karras.py:52-62)."""
+
+    def _u(self, t: jax.Array) -> jax.Array:
+        return jnp.clip(t.astype(jnp.float32) / max(self.timesteps - 1, 1), 0.0, 1.0)
+
+    def sigmas(self, t: jax.Array) -> jax.Array:
+        log_hi, log_lo = jnp.log(self.sigma_max), jnp.log(self.sigma_min)
+        return jnp.exp(log_hi + self._u(t) * (log_lo - log_hi))
+
+    def timesteps_from_sigmas(self, sigma: jax.Array) -> jax.Array:
+        log_hi, log_lo = jnp.log(self.sigma_max), jnp.log(self.sigma_min)
+        u = (jnp.log(sigma) - log_hi) / (log_lo - log_hi)
+        return jnp.clip(u, 0.0, 1.0) * (self.timesteps - 1)
+
+    def sample_timesteps(self, key: PRNGKey, n: int) -> jax.Array:
+        return jax.random.uniform(key, (n,)) * (self.timesteps - 1)
+
+
+class EDMNoiseSchedule(KarrasVENoiseSchedule):
+    """Karras ramp for inference, log-normal sigma sampling for training.
+
+    Training sigmas: ln(sigma) ~ N(p_mean, p_std) (EDM paper; reference
+    karras.py:64-76 samples t ~ N(0,1) then sigma = exp(p_std*t + p_mean)).
+    `sample_timesteps` returns ramp-domain steps via the inverse so the rest
+    of the pipeline stays in one timestep convention.
+    """
+
+    p_mean: float = flax.struct.field(pytree_node=False, default=-1.2)
+    p_std: float = flax.struct.field(pytree_node=False, default=1.2)
+
+    def sample_timesteps(self, key: PRNGKey, n: int) -> jax.Array:
+        z = jax.random.normal(key, (n,))
+        sigma = jnp.exp(self.p_std * z + self.p_mean)
+        sigma = jnp.clip(sigma, self.sigma_min, self.sigma_max)
+        return self.timesteps_from_sigmas(sigma)
+
+
+class CosineGeneralNoiseSchedule(SigmaSchedule):
+    """sigma-cosine: sigma(t) = tan(pi/2 * u) mapped into [smin, smax]
+    (reference cosine.py:20-32 CosineGeneralNoiseScheduler)."""
+
+    def _u(self, t: jax.Array) -> jax.Array:
+        return jnp.clip(t.astype(jnp.float32) / max(self.timesteps - 1, 1), 0.0, 1.0)
+
+    def sigmas(self, t: jax.Array) -> jax.Array:
+        theta_min = jnp.arctan(jnp.asarray(self.sigma_min))
+        theta_max = jnp.arctan(jnp.asarray(self.sigma_max))
+        # u=0 -> max noise, matching the Karras convention.
+        theta = theta_max + self._u(t) * (theta_min - theta_max)
+        return jnp.tan(theta)
+
+    def timesteps_from_sigmas(self, sigma: jax.Array) -> jax.Array:
+        theta_min = jnp.arctan(jnp.asarray(self.sigma_min))
+        theta_max = jnp.arctan(jnp.asarray(self.sigma_max))
+        u = (jnp.arctan(sigma) - theta_max) / (theta_min - theta_max)
+        return jnp.clip(u, 0.0, 1.0) * (self.timesteps - 1)
+
+    def sample_timesteps(self, key: PRNGKey, n: int) -> jax.Array:
+        return jax.random.uniform(key, (n,)) * (self.timesteps - 1)

@@ -1,0 +1,123 @@
+"""Shared type aliases and the dtype/precision/activation policy.
+
+Replaces the three duplicated string->object maps in the reference
+(reference: training.py:243-267, flaxdiff/utils.py:13-38,
+flaxdiff/inference/utils.py:92-117) with one canonical policy module.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+Dtype = Any
+PRNGKey = jax.Array
+
+DTYPE_MAP: dict[str, Optional[Dtype]] = {
+    "bfloat16": jnp.bfloat16,
+    "bf16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "fp16": jnp.float16,
+    "float32": jnp.float32,
+    "fp32": jnp.float32,
+    "float64": jnp.float64,
+    "none": None,
+    "": None,
+}
+
+PRECISION_MAP: dict[str, Optional[jax.lax.Precision]] = {
+    "default": jax.lax.Precision.DEFAULT,
+    "high": jax.lax.Precision.HIGH,
+    "highest": jax.lax.Precision.HIGHEST,
+    "none": None,
+    "": None,
+}
+
+ACTIVATION_MAP: dict[str, Callable] = {
+    "swish": jax.nn.swish,
+    "silu": jax.nn.silu,
+    "gelu": jax.nn.gelu,
+    "relu": jax.nn.relu,
+    "leaky_relu": jax.nn.leaky_relu,
+    "tanh": jnp.tanh,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "hard_swish": jax.nn.hard_swish,
+}
+
+
+def resolve_dtype(d: Union[str, Dtype, None]) -> Optional[Dtype]:
+    if d is None or not isinstance(d, str):
+        return d
+    key = d.lower()
+    if key not in DTYPE_MAP:
+        raise ValueError(f"Unknown dtype {d!r}; known: {sorted(DTYPE_MAP)}")
+    return DTYPE_MAP[key]
+
+
+def resolve_precision(p: Union[str, jax.lax.Precision, None]):
+    if p is None or not isinstance(p, str):
+        return p
+    key = p.lower()
+    if key not in PRECISION_MAP:
+        raise ValueError(f"Unknown precision {p!r}")
+    return PRECISION_MAP[key]
+
+
+def resolve_activation(a: Union[str, Callable]) -> Callable:
+    if callable(a):
+        return a
+    key = a.lower()
+    if key not in ACTIVATION_MAP:
+        raise ValueError(f"Unknown activation {a!r}")
+    return ACTIVATION_MAP[key]
+
+
+def dtype_name(d: Optional[Dtype]) -> str:
+    if d is None:
+        return "none"
+    return jnp.dtype(d).name
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Mixed-precision policy: which dtype to compute / store / reduce in.
+
+    TPU-first default: bf16 compute with f32 params and f32 reductions —
+    the MXU natively consumes bf16 while accumulating in f32.
+    """
+
+    param_dtype: Dtype = jnp.float32
+    compute_dtype: Dtype = jnp.bfloat16
+    output_dtype: Dtype = jnp.float32
+    precision: Optional[jax.lax.Precision] = None
+
+    @classmethod
+    def from_names(cls, param: str = "float32", compute: str = "bfloat16",
+                   output: str = "float32", precision: str = "none") -> "Policy":
+        return cls(
+            param_dtype=resolve_dtype(param) or jnp.float32,
+            compute_dtype=resolve_dtype(compute) or jnp.bfloat16,
+            output_dtype=resolve_dtype(output) or jnp.float32,
+            precision=resolve_precision(precision),
+        )
+
+    def cast_to_compute(self, tree: PyTree) -> PyTree:
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.compute_dtype)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating) else x,
+            tree,
+        )
+
+    def cast_to_param(self, tree: PyTree) -> PyTree:
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.param_dtype)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating) else x,
+            tree,
+        )
+
+
+DEFAULT_POLICY = Policy()
+FP32_POLICY = Policy(compute_dtype=jnp.float32)

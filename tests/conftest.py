@@ -1,0 +1,38 @@
+"""Test harness: force an 8-device virtual CPU platform before jax init.
+
+Multi-chip sharding logic is validated on a virtual CPU mesh
+(xla_force_host_platform_device_count) since real multi-chip hardware is
+unavailable in CI.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh(devices):
+    from flaxdiff_tpu.parallel import create_mesh
+    return create_mesh(axes={"data": 2, "fsdp": 4})
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

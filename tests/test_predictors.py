@@ -1,0 +1,85 @@
+"""Forward/backward identity tests for prediction transforms."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flaxdiff_tpu.predictors import (
+    DirectPredictionTransform,
+    EpsilonPredictionTransform,
+    KarrasPredictionTransform,
+    VPredictionTransform,
+    get_transform,
+)
+from flaxdiff_tpu.schedulers import (
+    CosineNoiseSchedule,
+    KarrasVENoiseSchedule,
+    LinearNoiseSchedule,
+)
+
+VP_TRANSFORMS = [EpsilonPredictionTransform, DirectPredictionTransform,
+                 VPredictionTransform]
+
+
+@pytest.mark.parametrize("tcls", VP_TRANSFORMS)
+@pytest.mark.parametrize("scls", [LinearNoiseSchedule, CosineNoiseSchedule])
+def test_forward_backward_identity_vp(tcls, scls):
+    """If the net predicted the exact target, to_x0_eps must recover (x0, eps)."""
+    s = scls(timesteps=100)
+    tr = tcls()
+    key = jax.random.PRNGKey(0)
+    x0 = jax.random.normal(key, (4, 8, 8, 3))
+    noise = jax.random.normal(jax.random.fold_in(key, 1), (4, 8, 8, 3))
+    t = jnp.asarray([5, 25, 60, 90])
+    x_t, target = tr.forward(s, x0, noise, t)
+    pred = tr.transform_output(x_t, t, target, s)
+    x0_hat, eps_hat = tr.to_x0_eps(x_t, t, pred, s)
+    np.testing.assert_allclose(x0_hat, x0, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(eps_hat, noise, rtol=1e-3, atol=1e-3)
+
+
+def test_forward_backward_identity_karras():
+    s = KarrasVENoiseSchedule(timesteps=100)
+    tr = KarrasPredictionTransform(sigma_data=0.5)
+    key = jax.random.PRNGKey(0)
+    x0 = jax.random.normal(key, (4, 8, 8, 3))
+    noise = jax.random.normal(jax.random.fold_in(key, 1), (4, 8, 8, 3))
+    t = jnp.asarray([5.0, 25.0, 60.0, 90.0])
+    x_t, target = tr.forward(s, x0, noise, t)
+    np.testing.assert_allclose(target, x0)  # EDM target is x0
+    # The exact raw net output F such that D = x0:
+    sigma, c_skip, c_out, c_in = tr._coeffs(s, t)
+    from flaxdiff_tpu.schedulers.common import bcast_right
+    raw = (x0 - bcast_right(c_skip, 4) * x_t) / bcast_right(c_out, 4)
+    pred = tr.transform_output(x_t, t, raw, s)
+    x0_hat, eps_hat = tr.to_x0_eps(x_t, t, pred, s)
+    np.testing.assert_allclose(x0_hat, x0, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(eps_hat, noise, rtol=1e-2, atol=1e-2)
+
+
+def test_karras_input_scale_matches_edm():
+    s = KarrasVENoiseSchedule(timesteps=100)
+    tr = KarrasPredictionTransform(sigma_data=0.5)
+    t = jnp.asarray([10.0, 50.0])
+    sigma = s.sigmas(t)
+    c_in = tr.input_scale(s, t)
+    np.testing.assert_allclose(c_in, 1.0 / jnp.sqrt(sigma**2 + 0.25), rtol=1e-5)
+
+
+def test_v_prediction_definition():
+    s = CosineNoiseSchedule(timesteps=100)
+    tr = VPredictionTransform()
+    key = jax.random.PRNGKey(2)
+    x0 = jax.random.normal(key, (2, 4, 4, 1))
+    noise = jax.random.normal(jax.random.fold_in(key, 1), (2, 4, 4, 1))
+    t = jnp.asarray([10, 70])
+    _, v = tr.forward(s, x0, noise, t)
+    signal, sigma = s.rates(t)
+    expected = (signal.reshape(-1, 1, 1, 1) * noise
+                - sigma.reshape(-1, 1, 1, 1) * x0)
+    np.testing.assert_allclose(v, expected, rtol=1e-5)
+
+
+def test_registry():
+    for name in ["epsilon", "x0", "v", "karras"]:
+        assert get_transform(name) is not None

@@ -1,0 +1,127 @@
+"""Property tests for scheduler math (reference has none — SURVEY.md §4)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flaxdiff_tpu.schedulers import (
+    CosineContinuousNoiseSchedule,
+    CosineGeneralNoiseSchedule,
+    CosineNoiseSchedule,
+    EDMNoiseSchedule,
+    ExpNoiseSchedule,
+    KarrasVENoiseSchedule,
+    LinearNoiseSchedule,
+    SimpleExpNoiseSchedule,
+    SqrtContinuousNoiseSchedule,
+    get_schedule,
+)
+
+ALL_SCHEDULES = [
+    LinearNoiseSchedule, CosineNoiseSchedule, ExpNoiseSchedule,
+    CosineContinuousNoiseSchedule, SqrtContinuousNoiseSchedule,
+    KarrasVENoiseSchedule, SimpleExpNoiseSchedule, EDMNoiseSchedule,
+    CosineGeneralNoiseSchedule,
+]
+
+
+@pytest.mark.parametrize("cls", ALL_SCHEDULES)
+def test_add_remove_noise_roundtrip(cls):
+    s = cls(timesteps=100)
+    key = jax.random.PRNGKey(0)
+    x0 = jax.random.normal(key, (4, 8, 8, 3))
+    noise = jax.random.normal(jax.random.fold_in(key, 1), (4, 8, 8, 3))
+    t = s.sample_timesteps(jax.random.fold_in(key, 2), 4)
+    x_t = s.add_noise(x0, noise, t)
+    rec = s.remove_all_noise(x_t, noise, t)
+    np.testing.assert_allclose(rec, x0, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("cls", ALL_SCHEDULES)
+def test_rates_shapes_and_positive(cls):
+    s = cls(timesteps=50)
+    t = s.sample_timesteps(jax.random.PRNGKey(0), 16)
+    signal, sigma = s.rates(t)
+    assert signal.shape == (16,) and sigma.shape == (16,)
+    assert bool(jnp.all(signal > 0)) and bool(jnp.all(sigma >= 0))
+    w = s.loss_weights(t)
+    assert w.shape == (16,) and bool(jnp.all(jnp.isfinite(w)))
+
+
+def test_discrete_vp_invariant():
+    """VP property: signal^2 + noise^2 == 1 for beta-based schedules."""
+    for cls in [LinearNoiseSchedule, CosineNoiseSchedule, ExpNoiseSchedule]:
+        s = cls(timesteps=1000)
+        t = jnp.arange(1000)
+        signal, sigma = s.rates(t)
+        np.testing.assert_allclose(signal**2 + sigma**2, 1.0, atol=1e-5)
+
+
+def test_linear_betas_match_closed_form():
+    s = LinearNoiseSchedule(timesteps=1000)
+    betas = np.linspace(1e-4, 0.02, 1000)
+    alphas_cumprod = np.cumprod(1 - betas)
+    np.testing.assert_allclose(s.alphas_cumprod, alphas_cumprod, rtol=1e-5)
+
+
+def test_cosine_alpha_bar_closed_form():
+    s = CosineNoiseSchedule(timesteps=1000)
+    ts = np.arange(1, 1001) / 1000
+    sref = 0.008
+    ab = (np.cos((ts + sref) / (1 + sref) * np.pi / 2) ** 2
+          / np.cos(sref / (1 + sref) * np.pi / 2) ** 2)
+    # beta clipping at 0.999 makes the tail deviate; check the first 90%.
+    np.testing.assert_allclose(s.alphas_cumprod[:900], ab[:900], rtol=2e-2)
+
+
+def test_karras_sigma_ramp_monotone_and_inverse():
+    s = KarrasVENoiseSchedule(timesteps=40, sigma_min=0.002, sigma_max=80.0)
+    t = jnp.arange(40, dtype=jnp.float32)
+    sigmas = s.sigmas(t)
+    assert float(sigmas[0]) == pytest.approx(80.0, rel=1e-4)
+    assert float(sigmas[-1]) == pytest.approx(0.002, rel=1e-4)
+    assert bool(jnp.all(jnp.diff(sigmas) < 0))
+    t_rec = s.timesteps_from_sigmas(sigmas)
+    np.testing.assert_allclose(t_rec, t, atol=1e-2)
+
+
+def test_edm_training_sigma_distribution():
+    s = EDMNoiseSchedule(timesteps=100)
+    t = s.sample_timesteps(jax.random.PRNGKey(0), 20000)
+    sigma = s.sigmas(t)
+    log_sigma = jnp.log(sigma)
+    # ln(sigma) ~ N(-1.2, 1.2) modulo clipping at the ramp edges
+    assert abs(float(jnp.median(log_sigma)) - (-1.2)) < 0.1
+
+
+def test_posterior_matches_ddpm_closed_form():
+    s = LinearNoiseSchedule(timesteps=100)
+    betas = np.array(s.betas)
+    ab = np.array(s.alphas_cumprod)
+    ab_prev = np.append(1.0, ab[:-1])
+    t = jnp.asarray([50])
+    x0 = jnp.ones((1, 4, 4, 1))
+    x_t = 0.5 * jnp.ones((1, 4, 4, 1))
+    mean = s.posterior_mean(x0, x_t, t)
+    c1 = betas[50] * np.sqrt(ab_prev[50]) / (1 - ab[50])
+    c2 = (1 - ab_prev[50]) * np.sqrt(1 - betas[50]) / (1 - ab[50])
+    np.testing.assert_allclose(mean, c1 * 1.0 + c2 * 0.5, rtol=1e-5)
+
+
+def test_registry():
+    for name in ["linear", "cosine", "exp", "karras", "edm", "sqrt",
+                 "cosine_continuous", "cosine_general", "simple_exp"]:
+        s = get_schedule(name, timesteps=10)
+        assert s.timesteps == 10
+
+
+def test_schedule_is_scan_carryable():
+    """Schedules are pytrees: usable as lax.scan carry / jit closure."""
+    s = CosineNoiseSchedule(timesteps=10)
+
+    @jax.jit
+    def f(s, x, t):
+        return s.add_noise(x, jnp.zeros_like(x), t)
+
+    out = f(s, jnp.ones((2, 4, 4, 1)), jnp.asarray([0, 5]))
+    assert out.shape == (2, 4, 4, 1)
