@@ -1,0 +1,4 @@
+"""Model families (capability parity: reference flaxdiff/models/)."""
+from . import common
+from .attention import AttentionLayer, BasicTransformerBlock, TransformerBlock
+from .unet import Unet

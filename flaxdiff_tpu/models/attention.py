@@ -1,0 +1,162 @@
+"""Attention modules built on the ops-layer dispatcher.
+
+Capability parity with reference flaxdiff/models/attention.py:34-380
+(EfficientAttention/NormalAttention -> one AttentionLayer with a backend
+switch; FlaxGEGLU/FlaxFeedForward -> GEGLUFeedForward; BasicTransformerBlock;
+TransformerBlock with optional projection). The flash path is the
+first-party Pallas kernel in ops/flash_attention.py.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+from ..typing import Dtype
+from .common import kernel_init
+
+
+class AttentionLayer(nn.Module):
+    """Multi-head self/cross attention over [B, L, C] (+[B,H,W,C] auto-flatten).
+
+    backend: "auto" | "flash" | "xla".
+    """
+
+    heads: int = 4
+    dim_head: int = 64
+    backend: str = "auto"
+    dtype: Optional[Dtype] = None
+    precision: Optional[jax.lax.Precision] = None
+    use_bias: bool = True
+    force_fp32_for_softmax: bool = True
+    kernel_init: Callable = kernel_init(1.0)
+
+    @nn.compact
+    def __call__(self, x: jax.Array, context: Optional[jax.Array] = None) -> jax.Array:
+        spatial = x.ndim == 4
+        if spatial:
+            b, h, w, c = x.shape
+            x = x.reshape(b, h * w, c)
+        context = x if context is None else context
+        inner = self.heads * self.dim_head
+        dense = lambda name: nn.DenseGeneral(
+            (self.heads, self.dim_head), use_bias=self.use_bias,
+            dtype=self.dtype, precision=self.precision,
+            kernel_init=self.kernel_init, name=name)
+        q = dense("to_q")(x)
+        k = dense("to_k")(context)
+        v = dense("to_v")(context)
+        out = dot_product_attention(
+            q, k, v, backend=self.backend,
+            force_fp32_for_softmax=self.force_fp32_for_softmax)
+        out = nn.DenseGeneral(
+            x.shape[-1], axis=(-2, -1), use_bias=self.use_bias,
+            dtype=self.dtype, precision=self.precision,
+            kernel_init=self.kernel_init, name="to_out")(out)
+        if spatial:
+            out = out.reshape(b, h, w, c)
+        return out
+
+
+class GEGLUFeedForward(nn.Module):
+    """GEGLU-gated MLP (reference attention.py:179-238)."""
+
+    dim_out: int
+    mult: int = 4
+    dtype: Optional[Dtype] = None
+    precision: Optional[jax.lax.Precision] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        inner = self.dim_out * self.mult
+        proj = nn.Dense(inner * 2, dtype=self.dtype, precision=self.precision,
+                        name="proj_in")(x)
+        gate, val = jnp.split(proj, 2, axis=-1)
+        x = val * jax.nn.gelu(gate)
+        return nn.Dense(self.dim_out, dtype=self.dtype,
+                        precision=self.precision, name="proj_out")(x)
+
+
+class BasicTransformerBlock(nn.Module):
+    """self-attn -> cross-attn -> GEGLU FF, pre-LN (reference 240-303)."""
+
+    heads: int = 4
+    dim_head: int = 64
+    backend: str = "auto"
+    dtype: Optional[Dtype] = None
+    precision: Optional[jax.lax.Precision] = None
+    use_bias: bool = True
+    force_fp32_for_softmax: bool = True
+    only_pure_attention: bool = False
+    use_cross_only: bool = False
+    kernel_init: Callable = kernel_init(1.0)
+
+    @nn.compact
+    def __call__(self, x: jax.Array, context: Optional[jax.Array] = None) -> jax.Array:
+        attn = lambda name: AttentionLayer(
+            heads=self.heads, dim_head=self.dim_head, backend=self.backend,
+            dtype=self.dtype, precision=self.precision, use_bias=self.use_bias,
+            force_fp32_for_softmax=self.force_fp32_for_softmax,
+            kernel_init=self.kernel_init, name=name)
+        ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
+        if self.only_pure_attention:
+            return attn("attn1")(ln("norm1")(x),
+                                 context if self.use_cross_only else None)
+        x = x + attn("attn1")(ln("norm1")(x),
+                              context if self.use_cross_only else None)
+        if context is not None and not self.use_cross_only:
+            x = x + attn("attn2")(ln("norm2")(x), context)
+        x = x + GEGLUFeedForward(x.shape[-1], dtype=self.dtype,
+                                 precision=self.precision, name="ff")(
+            ln("norm3")(x))
+        return x
+
+
+class TransformerBlock(nn.Module):
+    """Outer wrapper: optional in/out projection + residual around N basic
+    blocks (reference attention.py:305-380)."""
+
+    heads: int = 4
+    dim_head: int = 64
+    depth: int = 1
+    backend: str = "auto"
+    dtype: Optional[Dtype] = None
+    precision: Optional[jax.lax.Precision] = None
+    use_projection: bool = False
+    use_linear_attention: bool = True  # linear (Dense) vs conv projection
+    only_pure_attention: bool = False
+    use_self_and_cross: bool = True
+    force_fp32_for_softmax: bool = True
+    kernel_init: Callable = kernel_init(1.0)
+
+    @nn.compact
+    def __call__(self, x: jax.Array, context: Optional[jax.Array] = None) -> jax.Array:
+        spatial = x.ndim == 4
+        inner = self.heads * self.dim_head
+        residual = x
+        if spatial:
+            b, h, w, c = x.shape
+            x = x.reshape(b, h * w, c)
+        else:
+            c = x.shape[-1]
+        if self.use_projection:
+            x = nn.Dense(inner, dtype=self.dtype, precision=self.precision,
+                         name="proj_in")(x)
+        for i in range(self.depth):
+            x = BasicTransformerBlock(
+                heads=self.heads, dim_head=self.dim_head, backend=self.backend,
+                dtype=self.dtype, precision=self.precision,
+                force_fp32_for_softmax=self.force_fp32_for_softmax,
+                only_pure_attention=self.only_pure_attention,
+                use_cross_only=not self.use_self_and_cross and context is not None,
+                kernel_init=self.kernel_init, name=f"block_{i}")(
+                x, context=context)
+        if self.use_projection:
+            x = nn.Dense(c, dtype=self.dtype, precision=self.precision,
+                         kernel_init=kernel_init(0.0), name="proj_out")(x)
+        if spatial:
+            x = x.reshape(b, h, w, c)
+        return x + residual

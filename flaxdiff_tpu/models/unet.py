@@ -1,0 +1,145 @@
+"""Text-conditional UNet — the flagship convolutional backbone.
+
+Capability parity with reference flaxdiff/models/simple_unet.py:11-222
+(`Unet`): per-level feature_depths + attention_configs, res blocks with
+cross-attention on the last block of each level, middle res-attn-res, skip
+concats on the way up, final conv stage. Consciously fixed vs the
+reference (SURVEY.md §7.4): up-path attention reads its own level config
+(not middle_attention's force_fp32), and the up-path channel progression
+uses the mirrored level index explicitly.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..typing import Dtype
+from .attention import TransformerBlock
+from .common import (
+    ConvLayer,
+    Downsample,
+    FourierEmbedding,
+    ResidualBlock,
+    TimeProjection,
+    Upsample,
+    kernel_init,
+)
+
+
+class Unet(nn.Module):
+    output_channels: int = 3
+    emb_features: int = 256
+    feature_depths: Sequence[int] = (64, 128, 256, 512)
+    attention_configs: Optional[Sequence[Optional[dict]]] = None
+    num_res_blocks: int = 2
+    num_middle_res_blocks: int = 1
+    conv_type: str = "conv"
+    norm_groups: int = 8
+    activation: Callable = jax.nn.swish
+    dtype: Optional[Dtype] = None
+    precision: Optional[jax.lax.Precision] = None
+    kernel_init: Callable = kernel_init(1.0)
+
+    def _attn_cfg(self, level: int) -> Optional[dict]:
+        if self.attention_configs is None:
+            return None
+        cfg = self.attention_configs[level]
+        return dict(cfg) if cfg else None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, temb: jax.Array,
+                 textcontext: Optional[jax.Array] = None) -> jax.Array:
+        temb = FourierEmbedding(features=self.emb_features)(temb)
+        temb = TimeProjection(features=self.emb_features,
+                              dtype=self.dtype)(temb)
+
+        levels = len(self.feature_depths)
+        resblock = lambda feats, name: ResidualBlock(
+            conv_type=self.conv_type, features=feats,
+            norm_groups=self.norm_groups, activation=self.activation,
+            dtype=self.dtype, precision=self.precision,
+            kernel_init=self.kernel_init, name=name)
+
+        def attn_block(cfg, name):
+            cfg = dict(cfg)
+            cfg.pop("flash_attention", None)
+            return TransformerBlock(
+                heads=cfg.get("heads", 4),
+                dim_head=cfg.get("dim_head", 64),
+                depth=cfg.get("depth", 1),
+                backend=cfg.get("backend", "auto"),
+                use_projection=cfg.get("use_projection", False),
+                use_self_and_cross=cfg.get("use_self_and_cross", True),
+                only_pure_attention=cfg.get("only_pure_attention", False),
+                force_fp32_for_softmax=cfg.get("force_fp32_for_softmax", True),
+                dtype=self.dtype, precision=self.precision, name=name)
+
+        x = ConvLayer(self.conv_type, self.feature_depths[0], (3, 3), 1,
+                      dtype=self.dtype, precision=self.precision,
+                      kernel_init=self.kernel_init, name="conv_in")(x)
+        first_skip = x
+        skips = []
+
+        # --- down path ---------------------------------------------------
+        for level, feats in enumerate(self.feature_depths):
+            cfg = self._attn_cfg(level)
+            for block in range(self.num_res_blocks):
+                x = resblock(feats, f"down_{level}_res_{block}")(x, temb)
+                if cfg is not None and block == self.num_res_blocks - 1:
+                    x = attn_block(cfg, f"down_{level}_attn")(x, textcontext)
+                skips.append(x)
+            if level < levels - 1:
+                x = Downsample(feats, dtype=self.dtype,
+                               precision=self.precision,
+                               kernel_init=self.kernel_init,
+                               name=f"down_{level}_downsample")(x)
+
+        # --- middle ------------------------------------------------------
+        mid_feats = self.feature_depths[-1]
+        mid_cfg = self._attn_cfg(levels - 1)
+        for block in range(self.num_middle_res_blocks):
+            x = resblock(mid_feats, f"mid_res1_{block}")(x, temb)
+            if mid_cfg is not None:
+                mcfg = dict(mid_cfg)
+                mcfg["use_self_and_cross"] = False
+                x = attn_block(mcfg, f"mid_attn_{block}")(x, textcontext)
+            x = resblock(mid_feats, f"mid_res2_{block}")(x, temb)
+
+        # --- up path ------------------------------------------------------
+        for rev, feats in enumerate(reversed(self.feature_depths)):
+            level = levels - 1 - rev
+            cfg = self._attn_cfg(level)
+            for block in range(self.num_res_blocks):
+                skip = skips.pop()
+                x = jnp.concatenate([x, skip], axis=-1)
+                x = resblock(feats, f"up_{level}_res_{block}")(x, temb)
+                if cfg is not None and block == self.num_res_blocks - 1:
+                    x = attn_block(cfg, f"up_{level}_attn")(x, textcontext)
+            if level > 0:
+                next_feats = self.feature_depths[level - 1]
+                x = Upsample(next_feats, dtype=self.dtype,
+                             precision=self.precision,
+                             kernel_init=self.kernel_init,
+                             name=f"up_{level}_upsample")(x)
+
+        # --- output stage -------------------------------------------------
+        x = ConvLayer(self.conv_type, self.feature_depths[0], (3, 3), 1,
+                      dtype=self.dtype, precision=self.precision,
+                      kernel_init=self.kernel_init, name="conv_mid_out")(x)
+        x = jnp.concatenate([x, first_skip], axis=-1)
+        x = ResidualBlock(conv_type=self.conv_type,
+                          features=self.feature_depths[0],
+                          norm_groups=self.norm_groups,
+                          activation=self.activation, dtype=self.dtype,
+                          precision=self.precision,
+                          kernel_init=self.kernel_init, name="final_res")(x, temb)
+        x = nn.GroupNorm(self.norm_groups, dtype=jnp.float32,
+                         name="final_norm")(x)
+        x = self.activation(x)
+        x = ConvLayer("conv", self.output_channels, (3, 3), 1,
+                      dtype=jnp.float32, precision=self.precision,
+                      kernel_init=kernel_init(0.0), name="conv_out")(x)
+        return x

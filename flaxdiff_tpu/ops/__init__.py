@@ -1,0 +1,3 @@
+"""First-party TPU ops: Pallas kernels with XLA fallbacks."""
+from .attention import dot_product_attention, attention_backend_available
+from .fused_norm import fused_groupnorm_silu

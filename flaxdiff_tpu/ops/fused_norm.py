@@ -1,0 +1,83 @@
+"""Fused GroupNorm + SiLU Pallas kernel (resblock prologue).
+
+The reference runs GroupNorm and SiLU as separate XLA ops
+(reference flaxdiff/models/common.py:283-334); on TPU the two are
+HBM-bandwidth bound, so fusing the normalization statistics, affine and
+activation into one VMEM pass saves a round trip. Falls back to XLA when
+not on TPU or the sample doesn't fit VMEM.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# Per-sample VMEM budget for the fused kernel (bytes); larger samples fall
+# back to XLA which tiles fine on its own.
+_VMEM_SAMPLE_BYTES = 4 * 1024 * 1024
+
+
+def _gn_silu_kernel(x_ref, scale_ref, bias_ref, o_ref, *, groups: int,
+                    eps: float, apply_silu: bool):
+    x = x_ref[0].astype(jnp.float32)  # [HW, C]
+    hw, c = x.shape
+    cg = c // groups
+    xg = x.reshape(hw, groups, cg)
+    mean = jnp.mean(xg, axis=(0, 2), keepdims=True)
+    var = jnp.mean((xg - mean) ** 2, axis=(0, 2), keepdims=True)
+    xn = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(hw, c)
+    out = xn * scale_ref[...].astype(jnp.float32) + bias_ref[...].astype(jnp.float32)
+    if apply_silu:
+        out = out * jax.nn.sigmoid(out)
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _xla_groupnorm_silu(x, scale, bias, groups, eps, apply_silu):
+    b = x.shape[0]
+    c = x.shape[-1]
+    xf = x.astype(jnp.float32).reshape(b, -1, groups, c // groups)
+    mean = jnp.mean(xf, axis=(1, 3), keepdims=True)
+    var = jnp.mean((xf - mean) ** 2, axis=(1, 3), keepdims=True)
+    xn = ((xf - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+    out = xn * scale + bias
+    if apply_silu:
+        out = jax.nn.silu(out)
+    return out.astype(x.dtype)
+
+
+def fused_groupnorm_silu(x: jax.Array, scale: jax.Array, bias: jax.Array,
+                         groups: int = 8, eps: float = 1e-5,
+                         apply_silu: bool = True,
+                         interpret: bool = False,
+                         force_pallas: bool = False) -> jax.Array:
+    """x: [B, H, W, C] (or [B, L, C]); scale/bias: [C]."""
+    c = x.shape[-1]
+    assert c % groups == 0, f"channels {c} not divisible by groups {groups}"
+    orig_shape = x.shape
+    b = x.shape[0]
+    sample_bytes = int(jnp.prod(jnp.asarray(x.shape[1:]))) * 4
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not force_pallas and (not (on_tpu or interpret)
+                             or sample_bytes > _VMEM_SAMPLE_BYTES):
+        return _xla_groupnorm_silu(x, scale, bias, groups, eps, apply_silu)
+
+    xr = x.reshape(b, -1, c)
+    hw = xr.shape[1]
+    out = pl.pallas_call(
+        functools.partial(_gn_silu_kernel, groups=groups, eps=eps,
+                          apply_silu=apply_silu),
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)),
+            pl.BlockSpec((c,), lambda i: (0,)),
+            pl.BlockSpec((c,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, hw, c), x.dtype),
+        interpret=interpret,
+    )(xr, scale, bias)
+    return out.reshape(orig_shape)

@@ -1,0 +1,26 @@
+"""Samplers (capability parity: reference flaxdiff/samplers/__init__.py:1-7)."""
+from .common import DiffusionSampler, Sampler, get_timestep_spacing
+from .ddim import DDIMSampler
+from .ddpm import DDPMSampler, SimpleDDPMSampler
+from .euler import EulerAncestralSampler, EulerSampler, SimplifiedEulerSampler
+from .heun import HeunSampler
+from .multistep_dpm import MultiStepDPMSampler
+from .rk4 import RK4Sampler
+
+SAMPLER_REGISTRY = {
+    "ddpm": DDPMSampler,
+    "simple_ddpm": SimpleDDPMSampler,
+    "ddim": DDIMSampler,
+    "euler": EulerSampler,
+    "simple_euler": SimplifiedEulerSampler,
+    "euler_ancestral": EulerAncestralSampler,
+    "heun": HeunSampler,
+    "rk4": RK4Sampler,
+    "multistep_dpm": MultiStepDPMSampler,
+}
+
+
+def get_sampler(name: str, **kwargs) -> Sampler:
+    if name not in SAMPLER_REGISTRY:
+        raise ValueError(f"Unknown sampler {name!r}; known: {sorted(SAMPLER_REGISTRY)}")
+    return SAMPLER_REGISTRY[name](**kwargs)

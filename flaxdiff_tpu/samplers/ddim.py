@@ -1,0 +1,26 @@
+"""DDIM sampler with optional eta stochasticity
+(reference flaxdiff/samplers/ddim.py:19-49)."""
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from .common import Sampler
+
+
+class DDIMSampler(Sampler):
+    eta: float = flax.struct.field(pytree_node=False, default=0.0)
+
+    def step(self, denoise, x, t_cur, t_next, key, state, schedule, step_index):
+        b = x.shape[0]
+        x0, eps = denoise(x, t_cur)
+        signal_c, sh_c = self._coords(schedule, jnp.broadcast_to(t_cur, (b,)), x.ndim)
+        signal_n, sh_n = self._coords(schedule, jnp.broadcast_to(t_next, (b,)), x.ndim)
+        # eta=1 recovers ancestral; eta=0 is the deterministic ODE step.
+        var_up = (self.eta ** 2) * sh_n ** 2 * jnp.maximum(
+            sh_c ** 2 - sh_n ** 2, 0.0) / jnp.maximum(sh_c ** 2, 1e-24)
+        sigma_down = jnp.sqrt(jnp.maximum(sh_n ** 2 - var_up, 0.0))
+        noise = jax.random.normal(key, x.shape) if self.eta > 0 else 0.0
+        x_next = signal_n * (x0 + sigma_down * eps + jnp.sqrt(var_up) * noise)
+        return x_next, state

@@ -1,0 +1,51 @@
+"""Euler-family ODE samplers (reference flaxdiff/samplers/euler.py:6-55)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .common import Sampler
+
+
+class EulerSampler(Sampler):
+    """Probability-flow Euler in VE-ified sigma space: dx_hat/dsigma_hat = eps."""
+
+    def step(self, denoise, x, t_cur, t_next, key, state, schedule, step_index):
+        b = x.shape[0]
+        x0, eps = denoise(x, t_cur)
+        signal_c, sh_c = self._coords(schedule, jnp.broadcast_to(t_cur, (b,)), x.ndim)
+        signal_n, sh_n = self._coords(schedule, jnp.broadcast_to(t_next, (b,)), x.ndim)
+        x_hat = x / signal_c
+        x_hat_next = x_hat + eps * (sh_n - sh_c)
+        return signal_n * x_hat_next, state
+
+
+class SimplifiedEulerSampler(Sampler):
+    """x0-form Euler: steps toward the denoised estimate
+    (reference euler.py:20-32)."""
+
+    def step(self, denoise, x, t_cur, t_next, key, state, schedule, step_index):
+        b = x.shape[0]
+        x0, eps = denoise(x, t_cur)
+        signal_c, sh_c = self._coords(schedule, jnp.broadcast_to(t_cur, (b,)), x.ndim)
+        signal_n, sh_n = self._coords(schedule, jnp.broadcast_to(t_next, (b,)), x.ndim)
+        ratio = sh_n / jnp.maximum(sh_c, 1e-12)
+        x_hat_next = x0 + ratio * (x / signal_c - x0)
+        return signal_n * x_hat_next, state
+
+
+class EulerAncestralSampler(Sampler):
+    """Euler step to sigma_down + fresh-noise injection sigma_up
+    (reference euler.py:34-55) — the CLI's default validation sampler."""
+
+    def step(self, denoise, x, t_cur, t_next, key, state, schedule, step_index):
+        b = x.shape[0]
+        x0, eps = denoise(x, t_cur)
+        signal_c, sh_c = self._coords(schedule, jnp.broadcast_to(t_cur, (b,)), x.ndim)
+        signal_n, sh_n = self._coords(schedule, jnp.broadcast_to(t_next, (b,)), x.ndim)
+        var_up = sh_n ** 2 * jnp.maximum(sh_c ** 2 - sh_n ** 2, 0.0) / jnp.maximum(sh_c ** 2, 1e-24)
+        sigma_down = jnp.sqrt(jnp.maximum(sh_n ** 2 - var_up, 0.0))
+        x_hat = x / signal_c
+        x_hat_next = x_hat + eps * (sigma_down - sh_c)
+        noise = jax.random.normal(key, x.shape)
+        return signal_n * (x_hat_next + jnp.sqrt(var_up) * noise), state

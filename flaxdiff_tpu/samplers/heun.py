@@ -1,0 +1,47 @@
+"""Heun 2nd-order sampler (reference flaxdiff/samplers/heun_sampler.py:6-27).
+
+Two NFEs per step, both inside the scanned step function — the scan engine
+makes the trajectory a single XLA program either way.
+
+Formulated as the exponential-integrator Heun in log-SNR space (trapezoidal
+rule on the x0-prediction; DPM-Solver++(2S)-style):
+
+    lambda = -log(sigma_hat),  h = lambda_next - lambda_cur
+    x_hat_next = (sh_n / sh_c) * x_hat - expm1(-h) * 0.5 * (x0_c + x0_n)
+
+where x0_n is evaluated at the 1st-order (DDIM) predictor point. This is
+algebraically Heun's method on the probability-flow ODE but with the linear
+part integrated exactly, so the coefficients stay bounded even across the
+near-singular VP tail (signal -> 0, sigma_hat ~ 1e4) where naive
+sigma-space Heun amplifies model error by |delta sigma_hat|.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .common import Sampler
+
+
+class HeunSampler(Sampler):
+    def step(self, denoise, x, t_cur, t_next, key, state, schedule, step_index):
+        b = x.shape[0]
+        x0_c, _ = denoise(x, t_cur)
+        signal_c, sh_c = self._coords(schedule, jnp.broadcast_to(t_cur, (b,)), x.ndim)
+        signal_n, sh_n = self._coords(schedule, jnp.broadcast_to(t_next, (b,)), x.ndim)
+        sh_c = jnp.maximum(sh_c, 1e-8)
+        sh_n = jnp.maximum(sh_n, 1e-8)
+        ratio = sh_n / sh_c                                  # e^{-h}
+        growth = -jnp.expm1(jnp.log(sh_n) - jnp.log(sh_c))   # 1 - e^{-h}
+
+        x_hat = x / signal_c
+        # 1st-order (DDIM / exponential Euler) predictor
+        x_hat_euler = ratio * x_hat + growth * x0_c
+        # corrector: trapezoidal average of the x0 prediction
+        x0_n, _ = denoise(signal_n * x_hat_euler, t_next)
+        x_hat_heun = ratio * x_hat + growth * 0.5 * (x0_c + x0_n)
+        # at the terminal step (sigma_next ~ 0) the corrector input is the
+        # final sample itself; fall back to the predictor
+        use_heun = (sh_n > 1e-6).astype(x.dtype)
+        x_hat_next = use_heun * x_hat_heun + (1.0 - use_heun) * x_hat_euler
+        return signal_n * x_hat_next, state

@@ -1,0 +1,75 @@
+"""Multistep DPM-Solver++ (orders 1-3) with history in the scan carry.
+
+Capability parity with reference flaxdiff/samplers/multistep_dpm.py:8-58,
+which keeps a Python-side history list (stateful across calls — broken
+under jit). Here the previous denoised predictions and their log-SNR
+coordinates ride in the scan carry as fixed-shape arrays, so the solver is
+fully trace-safe inside the single-scan engine.
+
+Math: data-prediction DPM-Solver++ in lambda = -log(sigma_hat) space:
+  x_hat_next = (sh_n / sh_c) * x_hat - expm1(-h) * D_tilde,  h = l_n - l_c
+with D_tilde a 1st/2nd/3rd-order extrapolation of x0 predictions.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from .common import Sampler
+
+
+def _lambda_of(schedule, t) -> jax.Array:
+    """Scalar log-SNR coordinate lambda(t) = -log(sigma/signal)."""
+    signal, sigma = schedule.rates(jnp.reshape(t, (1,)).astype(jnp.float32))
+    sh = jnp.maximum(sigma[0] / jnp.maximum(signal[0], 1e-12), 1e-6)
+    return -jnp.log(sh)
+
+
+def _safe_div(a, b):
+    return a / jnp.where(jnp.abs(b) > 1e-12, b, jnp.ones_like(b))
+
+
+class MultiStepDPMSampler(Sampler):
+    order: int = flax.struct.field(pytree_node=False, default=2)
+
+    def init_state(self, x: jax.Array) -> Any:
+        zeros = jnp.zeros_like(x)
+        # (D_{i-1}, D_{i-2}, lambda_{i-1}, lambda_{i-2})
+        return (zeros, zeros, jnp.zeros(()), jnp.zeros(()))
+
+    def step(self, denoise, x, t_cur, t_next, key, state, schedule, step_index):
+        b = x.shape[0]
+        d_prev, d_prev2, l_prev, l_prev2 = state
+        x0, _ = denoise(x, t_cur)
+        signal_c, sh_c = self._coords(schedule, jnp.broadcast_to(t_cur, (b,)), x.ndim)
+        signal_n, sh_n = self._coords(schedule, jnp.broadcast_to(t_next, (b,)), x.ndim)
+        sh_c = jnp.maximum(sh_c, 1e-6)
+        sh_n = jnp.maximum(sh_n, 1e-6)
+        l_cur = _lambda_of(schedule, t_cur)
+        l_next = _lambda_of(schedule, t_next)
+        h = l_next - l_cur
+        h_prev = l_cur - l_prev
+        h_prev2 = l_prev - l_prev2
+
+        # 2nd order: linear extrapolation of D over lambda
+        slope1 = _safe_div(x0 - d_prev, h_prev)
+        d_tilde2 = x0 + 0.5 * h * slope1
+
+        # 3rd order: quadratic extrapolation using two previous predictions
+        slope2 = _safe_div(d_prev - d_prev2, h_prev2)
+        curv = _safe_div(slope1 - slope2, h_prev + h_prev2)
+        d_tilde3 = x0 + 0.5 * h * slope1 + (h ** 2 / 6.0) * curv
+
+        want = min(self.order, 3)
+        use2 = jnp.logical_and(step_index >= 1, want >= 2)
+        use3 = jnp.logical_and(step_index >= 2, want >= 3)
+        d_tilde = jnp.where(use3, d_tilde3, jnp.where(use2, d_tilde2, x0))
+
+        x_hat = x / signal_c
+        x_hat_next = (sh_n / sh_c) * x_hat - jnp.expm1(-h) * d_tilde
+        x_next = signal_n * x_hat_next
+        new_state = (x0, d_prev, l_cur, l_prev)
+        return x_next, new_state

@@ -66,10 +66,12 @@ class NoiseSchedule(flax.struct.PyTreeNode):
         return x, t
 
     def max_noise_std(self) -> jax.Array:
-        """Std-dev of x_T — used to scale initial sampling noise
-        (reference common.py `get_max_variance`)."""
-        signal, sigma = self.rates(jnp.asarray([self.timesteps - 1]))
-        return (sigma / jnp.maximum(signal, 1e-12))[0]
+        """Std-dev of the x_T marginal — scales initial sampling noise
+        (reference common.py `get_max_variance`). For VP schedules
+        signal(T) ~ 0, so x_T ~ sigma(T) * eps: return sigma(T), NOT
+        sigma/signal (which explodes as signal -> 0)."""
+        _, sigma = self.rates(jnp.asarray([self.timesteps - 1]))
+        return sigma[0]
 
     @property
     def is_continuous(self) -> bool:

@@ -44,8 +44,10 @@ class CosineContinuousNoiseSchedule(ContinuousNoiseSchedule):
         return jnp.ones_like(self._normalize(t))
 
     def max_noise_std(self) -> jax.Array:
-        signal, sigma = self.rates(jnp.asarray([1.0 - 1.0 / self.timesteps]))
-        return (sigma / jnp.maximum(signal, 1e-12))[0]
+        # x_T marginal std = sigma(T) (= sin(pi/2) = 1); NOT sigma/signal,
+        # which explodes as signal -> 0 (see NoiseSchedule.max_noise_std).
+        _, sigma = self.rates(jnp.asarray([1.0 - 1.0 / self.timesteps]))
+        return sigma[0]
 
 
 class SqrtContinuousNoiseSchedule(ContinuousNoiseSchedule):

@@ -4,6 +4,11 @@ Parity with reference flaxdiff/schedulers/karras.py: KarrasVENoiseScheduler
 (rho-ramp 13-17, EDM weight 19-24, log-sigma input transform 26-31, inverse
 33-45), SimpleExpNoiseScheduler (52-62), EDMNoiseScheduler (64-76), and
 cosine.py:20-32 CosineGeneralNoiseScheduler.
+
+Timestep convention: the whole framework uses ONE convention across VP and
+VE schedules — t ascending means more noise, so sigma(timesteps-1) ==
+sigma_max and sigma(0) == sigma_min. (The Karras paper indexes the other
+way; samplers here scan t from high to low, ending at t=0.)
 """
 from __future__ import annotations
 
@@ -18,9 +23,8 @@ from .common import SigmaSchedule
 class KarrasVENoiseSchedule(SigmaSchedule):
     """Karras et al. 2022 rho-spaced sigma ramp.
 
-    sigma(i) = (smax^(1/rho) + u * (smin^(1/rho) - smax^(1/rho)))^rho,
-    u = i / (timesteps - 1). i=0 is max noise, matching the samplers'
-    high-noise-first step convention.
+    sigma(t) = (smin^(1/rho) + u * (smax^(1/rho) - smin^(1/rho)))^rho,
+    u = t / (timesteps - 1); t = timesteps-1 is max noise.
     """
 
     rho: float = flax.struct.field(pytree_node=False, default=7.0)
@@ -31,12 +35,12 @@ class KarrasVENoiseSchedule(SigmaSchedule):
     def sigmas(self, t: jax.Array) -> jax.Array:
         inv_rho = 1.0 / self.rho
         lo, hi = self.sigma_min ** inv_rho, self.sigma_max ** inv_rho
-        return (hi + self._u(t) * (lo - hi)) ** self.rho
+        return (lo + self._u(t) * (hi - lo)) ** self.rho
 
     def timesteps_from_sigmas(self, sigma: jax.Array) -> jax.Array:
         inv_rho = 1.0 / self.rho
         lo, hi = self.sigma_min ** inv_rho, self.sigma_max ** inv_rho
-        u = (sigma ** inv_rho - hi) / (lo - hi)
+        u = (sigma ** inv_rho - lo) / (hi - lo)
         return jnp.clip(u, 0.0, 1.0) * (self.timesteps - 1)
 
     def sample_timesteps(self, key: PRNGKey, n: int) -> jax.Array:
@@ -50,12 +54,12 @@ class SimpleExpNoiseSchedule(SigmaSchedule):
         return jnp.clip(t.astype(jnp.float32) / max(self.timesteps - 1, 1), 0.0, 1.0)
 
     def sigmas(self, t: jax.Array) -> jax.Array:
-        log_hi, log_lo = jnp.log(self.sigma_max), jnp.log(self.sigma_min)
-        return jnp.exp(log_hi + self._u(t) * (log_lo - log_hi))
+        log_lo, log_hi = jnp.log(self.sigma_min), jnp.log(self.sigma_max)
+        return jnp.exp(log_lo + self._u(t) * (log_hi - log_lo))
 
     def timesteps_from_sigmas(self, sigma: jax.Array) -> jax.Array:
-        log_hi, log_lo = jnp.log(self.sigma_max), jnp.log(self.sigma_min)
-        u = (jnp.log(sigma) - log_hi) / (log_lo - log_hi)
+        log_lo, log_hi = jnp.log(self.sigma_min), jnp.log(self.sigma_max)
+        u = (jnp.log(sigma) - log_lo) / (log_hi - log_lo)
         return jnp.clip(u, 0.0, 1.0) * (self.timesteps - 1)
 
     def sample_timesteps(self, key: PRNGKey, n: int) -> jax.Array:
@@ -82,7 +86,7 @@ class EDMNoiseSchedule(KarrasVENoiseSchedule):
 
 
 class CosineGeneralNoiseSchedule(SigmaSchedule):
-    """sigma-cosine: sigma(t) = tan(pi/2 * u) mapped into [smin, smax]
+    """sigma-cosine: sigma(t) = tan(theta(u)) mapped into [smin, smax]
     (reference cosine.py:20-32 CosineGeneralNoiseScheduler)."""
 
     def _u(self, t: jax.Array) -> jax.Array:
@@ -91,14 +95,13 @@ class CosineGeneralNoiseSchedule(SigmaSchedule):
     def sigmas(self, t: jax.Array) -> jax.Array:
         theta_min = jnp.arctan(jnp.asarray(self.sigma_min))
         theta_max = jnp.arctan(jnp.asarray(self.sigma_max))
-        # u=0 -> max noise, matching the Karras convention.
-        theta = theta_max + self._u(t) * (theta_min - theta_max)
+        theta = theta_min + self._u(t) * (theta_max - theta_min)
         return jnp.tan(theta)
 
     def timesteps_from_sigmas(self, sigma: jax.Array) -> jax.Array:
         theta_min = jnp.arctan(jnp.asarray(self.sigma_min))
         theta_max = jnp.arctan(jnp.asarray(self.sigma_max))
-        u = (jnp.arctan(sigma) - theta_max) / (theta_min - theta_max)
+        u = (jnp.arctan(sigma) - theta_min) / (theta_max - theta_min)
         return jnp.clip(u, 0.0, 1.0) * (self.timesteps - 1)
 
     def sample_timesteps(self, key: PRNGKey, n: int) -> jax.Array:

@@ -7,7 +7,9 @@ unavailable in CI.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU regardless of any preset platform (e.g. a tunneled TPU): tests
+# must be hermetic, fast, and runnable in CI without accelerators.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,6 +18,13 @@ if "xla_force_host_platform_device_count" not in flags:
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
+
+# A site hook may have imported jax at interpreter startup with a different
+# JAX_PLATFORMS latched (e.g. a tunneled TPU); the env var above is then
+# ignored. Backends are not initialized yet at conftest-import time, so
+# updating the config directly still wins.
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

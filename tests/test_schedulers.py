@@ -26,6 +26,19 @@ ALL_SCHEDULES = [
 
 
 @pytest.mark.parametrize("cls", ALL_SCHEDULES)
+def test_max_noise_std_is_marginal_std(cls):
+    """max_noise_std scales initial sampling noise: it must be the x_T
+    marginal std sigma(T) — ~1 for VP schedules, sigma_max for VE — never
+    sigma/signal, which explodes as signal -> 0 at the VP tail."""
+    s = cls(timesteps=1000)
+    std = float(s.max_noise_std())
+    _, sigma_T = s.rates(jnp.asarray([float(s.timesteps - 1)]))
+    np.testing.assert_allclose(std, float(sigma_T[0]), rtol=1e-2)
+    if not s.is_continuous or not hasattr(s, "sigma_max"):
+        assert std <= 1.5, f"VP max_noise_std should be ~1, got {std}"
+
+
+@pytest.mark.parametrize("cls", ALL_SCHEDULES)
 def test_add_remove_noise_roundtrip(cls):
     s = cls(timesteps=100)
     key = jax.random.PRNGKey(0)
@@ -78,9 +91,10 @@ def test_karras_sigma_ramp_monotone_and_inverse():
     s = KarrasVENoiseSchedule(timesteps=40, sigma_min=0.002, sigma_max=80.0)
     t = jnp.arange(40, dtype=jnp.float32)
     sigmas = s.sigmas(t)
-    assert float(sigmas[0]) == pytest.approx(80.0, rel=1e-4)
-    assert float(sigmas[-1]) == pytest.approx(0.002, rel=1e-4)
-    assert bool(jnp.all(jnp.diff(sigmas) < 0))
+    # Framework-wide convention: t ascending == more noise (VP and VE alike).
+    assert float(sigmas[0]) == pytest.approx(0.002, rel=1e-4)
+    assert float(sigmas[-1]) == pytest.approx(80.0, rel=1e-4)
+    assert bool(jnp.all(jnp.diff(sigmas) > 0))
     t_rec = s.timesteps_from_sigmas(sigmas)
     np.testing.assert_allclose(t_rec, t, atol=1e-2)
 
