@@ -237,6 +237,26 @@ def _norm_factory(norm_groups: int, dtype) -> Callable[[], nn.Module]:
     return lambda name: nn.RMSNorm(dtype=jnp.float32, name=name)
 
 
+class FusedGroupNormSiLU(nn.Module):
+    """GroupNorm + SiLU through the fused Pallas kernel (ops/fused_norm.py).
+
+    Param names match nn.GroupNorm ('scale'/'bias'), so checkpoints are
+    interchangeable with the unfused (norm, swish) pair.
+    """
+
+    groups: int = 8
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        from ..ops.fused_norm import fused_groupnorm_silu
+        c = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        return fused_groupnorm_silu(x, scale, bias, groups=self.groups,
+                                    eps=self.eps)
+
+
 class ResidualBlock(nn.Module):
     """GroupNorm(/RMSNorm) -> swish -> conv -> +temb -> norm -> swish -> conv
     -> +skip(1x1) (reference common.py:258-337).
@@ -259,10 +279,19 @@ class ResidualBlock(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, temb: Optional[jax.Array] = None,
                  extra_features: Optional[jax.Array] = None) -> jax.Array:
-        norm = _norm_factory(self.norm_groups, self.dtype)
+        # swish IS jax.nn.silu (alias), so the fused GroupNorm+SiLU Pallas
+        # path engages for the default config.
+        fused = (self.norm_groups > 0
+                 and self.activation in (jax.nn.swish, jax.nn.silu))
+
+        def norm_act(h, name):
+            if fused:
+                return FusedGroupNormSiLU(self.norm_groups, name=name)(h)
+            norm = _norm_factory(self.norm_groups, self.dtype)
+            return self.activation(norm(name)(h))
+
         residual = x
-        h = norm("norm1")(x)
-        h = self.activation(h)
+        h = norm_act(x, "norm1")
         h = ConvLayer(self.conv_type, self.features, self.kernel_size,
                       self.strides, padding=self.padding, dtype=self.dtype,
                       precision=self.precision, kernel_init=self.kernel_init,
@@ -272,8 +301,7 @@ class ResidualBlock(nn.Module):
                                  kernel_init=self.kernel_init, name="temb_proj")(
                 self.activation(temb))
             h = h + temb_proj[:, None, None, :]
-        h = norm("norm2")(h)
-        h = self.activation(h)
+        h = norm_act(h, "norm2")
         h = ConvLayer(self.conv_type, self.features, self.kernel_size, 1,
                       padding=self.padding, dtype=self.dtype,
                       precision=self.precision,

@@ -56,11 +56,26 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     assert q.ndim == 4 and k.ndim == 4 and v.ndim == 4
     use_flash = False
     if backend in ("auto", "flash") and attention_backend_available("flash"):
-        # The Pallas kernel wants lane-aligned head_dim and a reasonable
-        # sequence; tiny shapes fall back to XLA.
-        use_flash = q.shape[-1] % 128 == 0 and q.shape[1] >= 128
+        # Sequences shorter than one q block gain nothing from the kernel;
+        # head_dim is lane-padded to 128 below, so any head size qualifies.
+        use_flash = q.shape[1] >= 128
     if use_flash:
         from .flash_attention import flash_attention
-        return flash_attention(q, k, v, scale=scale)
+        d = q.shape[-1]
+        scale_eff = scale if scale is not None else 1.0 / (d ** 0.5)
+        pad = (-d) % 128
+        if pad:
+            # Zero-padding head_dim is exact: padded dims contribute 0 to
+            # q·k logits (scale stays 1/sqrt(d_orig)) and 0 to the padded
+            # output channels, which are sliced off.
+            widths = ((0, 0), (0, 0), (0, 0), (0, pad))
+            out = flash_attention(jnp.pad(q, widths), jnp.pad(k, widths),
+                                  jnp.pad(v, widths), scale=scale_eff)
+            return out[..., :d]
+        return flash_attention(q, k, v, scale=scale_eff)
+    if backend == "flash" and not attention_backend_available("flash"):
+        import warnings
+        warnings.warn("backend='flash' requested but no TPU is available; "
+                      "falling back to XLA attention", stacklevel=2)
     return _xla_attention(q, k, v, scale=scale,
                           force_fp32_for_softmax=force_fp32_for_softmax)

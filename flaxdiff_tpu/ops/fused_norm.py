@@ -9,6 +9,7 @@ not on TPU or the sample doesn't fit VMEM.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional
 
 import jax
@@ -48,17 +49,14 @@ def _xla_groupnorm_silu(x, scale, bias, groups, eps, apply_silu):
     return out.astype(x.dtype)
 
 
-def fused_groupnorm_silu(x: jax.Array, scale: jax.Array, bias: jax.Array,
-                         groups: int = 8, eps: float = 1e-5,
-                         apply_silu: bool = True,
-                         interpret: bool = False,
-                         force_pallas: bool = False) -> jax.Array:
-    """x: [B, H, W, C] (or [B, L, C]); scale/bias: [C]."""
+def _impl(x: jax.Array, scale: jax.Array, bias: jax.Array,
+          groups: int, eps: float, apply_silu: bool,
+          interpret: bool, force_pallas: bool) -> jax.Array:
     c = x.shape[-1]
     assert c % groups == 0, f"channels {c} not divisible by groups {groups}"
     orig_shape = x.shape
     b = x.shape[0]
-    sample_bytes = int(jnp.prod(jnp.asarray(x.shape[1:]))) * 4
+    sample_bytes = math.prod(x.shape[1:]) * 4
 
     on_tpu = jax.devices()[0].platform == "tpu"
     if not force_pallas and (not (on_tpu or interpret)
@@ -81,3 +79,41 @@ def fused_groupnorm_silu(x: jax.Array, scale: jax.Array, bias: jax.Array,
         interpret=interpret,
     )(xr, scale, bias)
     return out.reshape(orig_shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _fused_gn_silu(x, scale, bias, groups, eps, apply_silu, interpret,
+                   force_pallas):
+    return _impl(x, scale, bias, groups, eps, apply_silu, interpret,
+                 force_pallas)
+
+
+def _gn_fwd(x, scale, bias, groups, eps, apply_silu, interpret, force_pallas):
+    out = _impl(x, scale, bias, groups, eps, apply_silu, interpret,
+                force_pallas)
+    return out, (x, scale, bias)
+
+
+def _gn_bwd(groups, eps, apply_silu, interpret, force_pallas, res, g):
+    # Backward recomputes through the XLA reference path — correct
+    # gradients with the Pallas kernel on the forward (a dedicated
+    # backward kernel is a later optimization, same policy as
+    # flash_attention._bwd).
+    x, scale, bias = res
+    _, vjp = jax.vjp(
+        lambda x_, s_, b_: _xla_groupnorm_silu(x_, s_, b_, groups, eps,
+                                               apply_silu), x, scale, bias)
+    return vjp(g)
+
+
+_fused_gn_silu.defvjp(_gn_fwd, _gn_bwd)
+
+
+def fused_groupnorm_silu(x: jax.Array, scale: jax.Array, bias: jax.Array,
+                         groups: int = 8, eps: float = 1e-5,
+                         apply_silu: bool = True,
+                         interpret: bool = False,
+                         force_pallas: bool = False) -> jax.Array:
+    """x: [B, H, W, C] (or [B, L, C]); scale/bias: [C]. Differentiable."""
+    return _fused_gn_silu(x, scale, bias, groups, eps, apply_silu,
+                          interpret, force_pallas)

@@ -1,0 +1,34 @@
+"""Parallelism layer: device meshes, partition rules, FSDP sharding.
+
+The reference is data-parallel only (SURVEY.md §2: `Mesh(jax.devices(),
+'data')`, replicated params, `lax.pmean` grads — trainer/simple_trainer.py:176,
+general_diffusion_trainer.py:325). This layer is the TPU-native upgrade:
+N-D ICI meshes (data/fsdp/tensor/seq), per-tensor PartitionSpec rules,
+automatic FSDP sharding inference, and sequence-parallel collectives —
+all through `jax.sharding.NamedSharding` so XLA SPMD emits the
+reduce-scatter/all-gather pattern over ICI.
+"""
+from .mesh import MeshAxes, create_mesh, local_batch_size, mesh_shape_for
+from .partition import (
+    PartitionRule,
+    fsdp_sharding_tree,
+    infer_fsdp_spec,
+    match_partition_rules,
+    shard_pytree,
+    sharding_tree,
+    with_named_constraint,
+)
+
+__all__ = [
+    "MeshAxes",
+    "create_mesh",
+    "local_batch_size",
+    "mesh_shape_for",
+    "PartitionRule",
+    "match_partition_rules",
+    "infer_fsdp_spec",
+    "fsdp_sharding_tree",
+    "sharding_tree",
+    "shard_pytree",
+    "with_named_constraint",
+]

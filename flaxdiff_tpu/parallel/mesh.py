@@ -1,0 +1,120 @@
+"""Mesh topology: N-D ICI device meshes, DCN-aware for multi-slice.
+
+Replaces the reference's fixed 1-D `Mesh(jax.devices(), 'data')`
+(trainer/simple_trainer.py:176) with a general axis-dict construction:
+`create_mesh(axes={"data": 2, "fsdp": 4})`. Axis sizes of -1 are inferred
+from the device count; multi-host (multi-slice) topologies place the
+leading axis across DCN via `mesh_utils.create_hybrid_device_mesh` so
+gradient reduction rides DCN once while FSDP gathers stay on ICI.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+# Canonical axis names. data: batch parallel; fsdp: param/optimizer sharding;
+# tensor: tensor parallel (per-op head/feature sharding); seq: sequence /
+# context parallel (ring attention).
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_TENSOR = "tensor"
+AXIS_SEQ = "seq"
+CANONICAL_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_TENSOR, AXIS_SEQ)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshAxes:
+    """Declarative mesh request; -1 means infer from device count."""
+
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    seq: int = 1
+
+    def as_dict(self) -> Dict[str, int]:
+        return {
+            AXIS_DATA: self.data,
+            AXIS_FSDP: self.fsdp,
+            AXIS_TENSOR: self.tensor,
+            AXIS_SEQ: self.seq,
+        }
+
+
+def _resolve_sizes(axes: Dict[str, int], n_devices: int) -> Dict[str, int]:
+    sizes = dict(axes)
+    unknown = [k for k, v in sizes.items() if v == -1]
+    known = math.prod(v for v in sizes.values() if v != -1)
+    if len(unknown) > 1:
+        raise ValueError(f"At most one axis may be -1, got {unknown}")
+    if unknown:
+        if n_devices % known != 0:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fixed axes product {known}")
+        sizes[unknown[0]] = n_devices // known
+    total = math.prod(sizes.values())
+    if total != n_devices:
+        raise ValueError(
+            f"Mesh axes {sizes} use {total} devices but {n_devices} available")
+    return sizes
+
+
+def create_mesh(axes: Optional[Dict[str, int]] = None,
+                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh from an axis-name -> size dict (drop size-0 axes,
+    keep size-1 axes so PartitionSpecs stay valid across configs).
+
+    Single-slice: `mesh_utils.create_device_mesh` picks an ICI-friendly
+    device order. Multi-slice (num_slices > 1): hybrid mesh with the
+    leading (data) axis across DCN.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if axes is None:
+        axes = {AXIS_DATA: -1}
+    axes = {k: v for k, v in axes.items() if v != 0}
+    sizes = _resolve_sizes(axes, len(devices))
+    names = tuple(sizes)
+    shape = tuple(sizes[n] for n in names)
+
+    num_slices = getattr(devices[0], "num_slices", 1) or 1
+    if num_slices > 1 and shape[0] % num_slices == 0:
+        dcn_shape = (num_slices,) + (1,) * (len(shape) - 1)
+        ici_shape = (shape[0] // num_slices,) + shape[1:]
+        mesh_devices = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices)
+    else:
+        mesh_devices = mesh_utils.create_device_mesh(shape, devices=devices)
+    return Mesh(mesh_devices, names)
+
+
+def mesh_shape_for(mesh: Mesh) -> Dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def local_batch_size(mesh: Mesh, global_batch_size: int,
+                     axis: str = AXIS_DATA) -> int:
+    """Per-process batch size for host-sharded input pipelines
+    (reference: data/dataloaders.py:297 batch_size // process_count)."""
+    if global_batch_size % jax.process_count() != 0:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by "
+            f"{jax.process_count()} processes")
+    return global_batch_size // jax.process_count()
+
+
+def batch_spec(mesh: Mesh) -> jax.sharding.PartitionSpec:
+    """PartitionSpec for batch tensors: shard dim 0 over every data-like
+    axis present in the mesh (data × fsdp both contribute to batch
+    parallelism under FSDP; tensor/seq axes replicate the batch)."""
+    batch_axes = tuple(a for a in (AXIS_DATA, AXIS_FSDP)
+                       if a in mesh.axis_names and
+                       mesh.devices.shape[mesh.axis_names.index(a)] > 1)
+    if not batch_axes:
+        batch_axes = (AXIS_DATA,) if AXIS_DATA in mesh.axis_names else ()
+    return jax.sharding.PartitionSpec(batch_axes if len(batch_axes) > 1
+                                      else (batch_axes[0] if batch_axes else None))

@@ -1,0 +1,20 @@
+"""Trainer layer: FSDP-sharded diffusion training.
+
+Capability parity with the reference trainer hierarchy (SimpleTrainer ->
+DiffusionTrainer -> GeneralDiffusionTrainer, flaxdiff/trainer/*), built
+TPU-first: one `jax.jit` train step over NamedSharding (params + optimizer
+state sharded on the `fsdp` axis, batch on `data`), donated state, EMA as
+a sharded pytree update, CFG dropout by `jnp.where` null-embedding mask,
+and no per-step host sync (loss is read back only at the log cadence).
+"""
+from .train_state import TrainState
+from .train_step import TrainStepConfig, make_train_step
+from .trainer import DiffusionTrainer, TrainerConfig
+
+__all__ = [
+    "TrainState",
+    "TrainStepConfig",
+    "make_train_step",
+    "DiffusionTrainer",
+    "TrainerConfig",
+]

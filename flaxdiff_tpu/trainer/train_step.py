@@ -1,0 +1,137 @@
+"""The diffusion train step: one pure function, jitted once over the mesh.
+
+Parity with reference trainer/general_diffusion_trainer.py:248-349
+(normalize -> optional VAE encode -> CFG uncond dropout -> timestep
+sampling -> forward diffusion -> weighted MSE -> grad -> EMA), with the
+TPU-native differences:
+
+- No shard_map / lax.pmean / local_device_index plumbing: the step is
+  `jax.jit` over NamedSharding; XLA SPMD inserts the gradient
+  reduce-scatter and batch-collectives (reference needed
+  general_diffusion_trainer.py:325 pmean + diffusion_trainer.py:158
+  fold_in(local_device_index)).
+- RNG: one global key folded with the step counter; noise for the global
+  batch is generated inside the jit program, sharded like the batch.
+- Loss stays on device; the caller reads it back only at log cadence
+  (the reference syncs every step for its NaN check,
+  simple_trainer.py:542).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..predictors import PredictionTransform
+from ..schedulers.common import NoiseSchedule, bcast_right
+from ..typing import Policy, PyTree
+from ..utils import normalize_images
+from .train_state import TrainState
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainStepConfig:
+    """Static configuration closed over by the jitted step."""
+
+    uncond_prob: float = 0.12          # CFG dropout (reference training.py:213)
+    ema_decay: float = 0.999
+    normalize: bool = True             # (x-127.5)/127.5 inside the step
+    weighted_loss: bool = True         # schedule loss weights (P2 / EDM)
+    clip_grad_handled_by_tx: bool = True
+
+
+def make_train_step(
+    apply_fn: Callable[[PyTree, jax.Array, jax.Array, Any], jax.Array],
+    schedule: NoiseSchedule,
+    transform: PredictionTransform,
+    config: TrainStepConfig = TrainStepConfig(),
+    policy: Optional[Policy] = None,
+    autoencoder: Optional[Any] = None,
+    null_cond: Optional[PyTree] = None,
+) -> Callable[[TrainState, PyTree], Tuple[TrainState, jax.Array]]:
+    """Build the pure train step.
+
+    apply_fn(params, x_t, t, cond) -> raw network output.
+    Batch contract: {"sample": [B,...] images (uint8 or [-1,1] float),
+    "cond": optional conditioning pytree (e.g. {"text": [B,L,D]})}.
+    `null_cond` is the cached unconditional embedding tree used for the
+    jnp.where CFG-dropout splice (the reference's correct semantics,
+    inputs/__init__.py:122-137 — not the prefix-splice variant).
+    """
+
+    def train_step(state: TrainState, batch: PyTree) -> Tuple[TrainState, jax.Array]:
+        rng = jax.random.fold_in(state.rng, state.step)
+        noise_key, t_key, uncond_key, vae_key = jax.random.split(rng, 4)
+
+        x0 = batch["sample"]
+        if config.normalize:
+            x0 = normalize_images(x0)
+        else:
+            x0 = x0.astype(jnp.float32)
+
+        if autoencoder is not None:
+            x0 = autoencoder.encode(x0, key=vae_key)
+
+        cond = batch.get("cond", None)
+        if cond is not None and null_cond is not None and config.uncond_prob > 0:
+            B = x0.shape[0]
+            uncond_mask = jax.random.bernoulli(
+                uncond_key, config.uncond_prob, (B,))
+
+            def splice(c, u):
+                mask = uncond_mask.reshape((B,) + (1,) * (c.ndim - 1))
+                return jnp.where(mask, u.astype(c.dtype), c)
+
+            cond = jax.tree_util.tree_map(splice, cond, null_cond)
+
+        B = x0.shape[0]
+        t = schedule.sample_timesteps(t_key, B)
+        noise = jax.random.normal(noise_key, x0.shape, dtype=x0.dtype)
+        x_t, target = transform.forward(schedule, x0, noise, t)
+
+        c_in = bcast_right(transform.input_scale(schedule, t), x_t.ndim)
+        x_in, t_in = schedule.transform_inputs(x_t * c_in, t.astype(jnp.float32))
+
+        weights = (schedule.loss_weights(t) if config.weighted_loss
+                   else jnp.ones_like(t, dtype=jnp.float32))
+
+        def loss_fn(params):
+            if policy is not None:
+                params_c = policy.cast_to_compute(params)
+                x_net = x_in.astype(policy.compute_dtype)
+            else:
+                params_c, x_net = params, x_in
+            raw = apply_fn(params_c, x_net, t_in, cond).astype(jnp.float32)
+            pred = transform.transform_output(x_t, t.astype(jnp.float32),
+                                              raw, schedule)
+            per_sample = jnp.mean(
+                (pred - target) ** 2,
+                axis=tuple(range(1, pred.ndim)))
+            return jnp.mean(per_sample * weights)
+
+        if state.dynamic_scale is not None:
+            grad_fn = state.dynamic_scale.value_and_grad(loss_fn)
+            dyn, is_fin, loss, grads = grad_fn(state.params)
+            new_state = state.apply_gradients(grads)
+            # restore params/opt_state where the scaled grads overflowed
+            # (reference diffusion_trainer.py:229-240)
+            new_state = new_state.replace(
+                params=jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(is_fin, n, o),
+                    new_state.params, state.params),
+                opt_state=jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(is_fin, n, o),
+                    new_state.opt_state, state.opt_state),
+                dynamic_scale=dyn,
+            )
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            new_state = state.apply_gradients(grads)
+
+        new_state = new_state.apply_ema(config.ema_decay)
+        return new_state, loss
+
+    return train_step

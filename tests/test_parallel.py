@@ -1,0 +1,117 @@
+"""Parallel layer tests on the 8-device virtual CPU mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flaxdiff_tpu.parallel import (
+    create_mesh,
+    fsdp_sharding_tree,
+    infer_fsdp_spec,
+    match_partition_rules,
+    shard_pytree,
+    sharding_tree,
+)
+from flaxdiff_tpu.parallel.mesh import batch_spec, mesh_shape_for
+
+
+class TestCreateMesh:
+    def test_default_1d(self):
+        m = create_mesh()
+        assert m.axis_names == ("data",)
+        assert m.devices.size == 8
+
+    def test_2d_explicit(self, mesh):
+        assert mesh_shape_for(mesh) == {"data": 2, "fsdp": 4}
+
+    def test_inferred_axis(self):
+        m = create_mesh(axes={"data": -1, "fsdp": 2})
+        assert mesh_shape_for(m) == {"data": 4, "fsdp": 2}
+
+    def test_size_zero_axis_dropped(self):
+        m = create_mesh(axes={"data": -1, "seq": 0})
+        assert m.axis_names == ("data",)
+
+    def test_bad_sizes_raise(self):
+        with pytest.raises(ValueError):
+            create_mesh(axes={"data": 3, "fsdp": 2})
+        with pytest.raises(ValueError):
+            create_mesh(axes={"data": -1, "fsdp": -1})
+
+    def test_seq_axis(self):
+        m = create_mesh(axes={"data": 2, "seq": 4})
+        assert mesh_shape_for(m) == {"data": 2, "seq": 4}
+
+
+class TestPartitionRules:
+    def test_match_order(self):
+        tree = {"layer": {"kernel": np.zeros((4, 4)), "bias": np.zeros(4)}}
+        rules = [
+            ("kernel", P(None, "fsdp")),
+            (".*", P()),
+        ]
+        specs = match_partition_rules(rules, tree)
+        assert specs["layer"]["kernel"] == P(None, "fsdp")
+        assert specs["layer"]["bias"] == P()
+
+    def test_unmatched_raises(self):
+        with pytest.raises(ValueError):
+            match_partition_rules([("nope", P())], {"a": np.zeros(2)})
+
+
+class TestInferFsdp:
+    def test_small_replicated(self, mesh):
+        assert infer_fsdp_spec((32,), mesh) == P()
+
+    def test_large_dense_sharded_on_biggest_dim(self, mesh):
+        # fsdp axis = 4; both dims divisible; larger one wins
+        assert infer_fsdp_spec((512, 2048), mesh, min_size=0) == P(None, "fsdp")
+        assert infer_fsdp_spec((2048, 512), mesh, min_size=0) == P("fsdp", None)
+
+    def test_conv_kernel_shards_cout(self, mesh):
+        spec = infer_fsdp_spec((3, 3, 256, 256), mesh, min_size=0)
+        assert spec == P(None, None, None, "fsdp")
+
+    def test_indivisible_replicated(self, mesh):
+        assert infer_fsdp_spec((7, 9), mesh, min_size=0) == P()
+
+    def test_no_fsdp_axis(self):
+        m = create_mesh(axes={"data": -1})
+        assert infer_fsdp_spec((1024, 1024), m) == P()
+
+
+class TestShardingTree:
+    def test_end_to_end_shard(self, mesh):
+        params = {
+            "dense": {"kernel": np.ones((256, 1024), np.float32),
+                      "bias": np.zeros((1024,), np.float32)},
+        }
+        specs = fsdp_sharding_tree(params, mesh)
+        assert specs["dense"]["kernel"] == P(None, "fsdp")
+        assert specs["dense"]["bias"] == P()
+        sharded = shard_pytree(params, specs, mesh)
+        k = sharded["dense"]["kernel"]
+        assert isinstance(k.sharding, NamedSharding)
+        assert k.sharding.spec == P(None, "fsdp")
+        # each fsdp shard holds 1024/4 columns
+        shard_shapes = {s.data.shape for s in k.addressable_shards}
+        assert shard_shapes == {(256, 256)}
+
+    def test_computation_matches_replicated(self, mesh):
+        x = np.random.default_rng(0).normal(size=(8, 256)).astype(np.float32)
+        w = np.random.default_rng(1).normal(size=(256, 512)).astype(np.float32)
+        specs = {"w": infer_fsdp_spec(w.shape, mesh, min_size=0)}
+        sharded_w = shard_pytree({"w": w}, specs, mesh)["w"]
+
+        @jax.jit
+        def f(x, w):
+            return x @ w
+
+        np.testing.assert_allclose(f(x, sharded_w), x @ w, rtol=1e-5)
+
+
+def test_batch_spec(mesh):
+    assert batch_spec(mesh) == P(("data", "fsdp"))
+    m1 = create_mesh(axes={"data": -1})
+    assert batch_spec(m1) == P("data")

@@ -91,8 +91,16 @@ def test_ve_sampler_converges_to_delta(sampler):
     np.testing.assert_allclose(np.asarray(out), MU, atol=0.06)
 
 
-def test_gaussian_marginal_std():
-    """Perfect model for N(0, c^2): samplers must reproduce std c."""
+@pytest.mark.parametrize("sampler", VP_SAMPLERS,
+                         ids=lambda s: type(s).__name__ + str(getattr(s, "order", "")))
+def test_vp_gaussian_marginal_std(sampler):
+    """Perfect model for N(0, c^2): samplers must reproduce std c.
+
+    Unlike the delta tests this IS trajectory-sensitive: the terminal
+    denoise of a stalled trajectory (x still near full noise) yields
+    std far above c, so any sampler that fails to remove noise along the
+    way fails here (this caught the adjacent-step DDPM posterior bug).
+    """
     c = 0.4
     schedule = CosineNoiseSchedule(timesteps=1000)
 
@@ -104,7 +112,26 @@ def test_gaussian_marginal_std():
 
     engine = DiffusionSampler(model_fn=model_fn, schedule=schedule,
                               transform=EpsilonPredictionTransform(),
-                              sampler=DDIMSampler())
+                              sampler=sampler)
+    out = engine.generate_samples(params=None, num_samples=64, resolution=8,
+                                  diffusion_steps=100,
+                                  rngstate=RngSeq.create(1), channels=1)
+    std = float(jnp.std(out))
+    assert abs(std - c) < 0.06, f"std {std} vs expected {c}"
+
+
+@pytest.mark.parametrize("sampler", VE_SAMPLERS, ids=lambda s: type(s).__name__)
+def test_ve_gaussian_marginal_std(sampler):
+    c = 0.4
+    schedule = KarrasVENoiseSchedule(timesteps=1000, sigma_max=20.0)
+
+    def model_fn(params, x, t, cond):
+        sg = bcast_right(jnp.exp(4.0 * t), x.ndim)  # invert c_noise
+        return sg * x / (c ** 2 + sg ** 2)
+
+    engine = DiffusionSampler(model_fn=model_fn, schedule=schedule,
+                              transform=EpsilonPredictionTransform(),
+                              sampler=sampler)
     out = engine.generate_samples(params=None, num_samples=64, resolution=8,
                                   diffusion_steps=100,
                                   rngstate=RngSeq.create(1), channels=1)

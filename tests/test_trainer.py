@@ -1,0 +1,127 @@
+"""Trainer tests: FSDP-sharded diffusion training on the virtual mesh.
+
+Validates state sharding, a real loss decrease on a toy denoising task,
+EMA tracking, CFG dropout splice, and abnormal-loss recovery.
+"""
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from flaxdiff_tpu.predictors import EpsilonPredictionTransform
+from flaxdiff_tpu.schedulers import CosineNoiseSchedule
+from flaxdiff_tpu.trainer import DiffusionTrainer, TrainerConfig
+
+
+class TinyDenoiser(nn.Module):
+    """A small conv net: enough capacity to learn eps on a toy dataset."""
+    features: int = 32
+
+    @nn.compact
+    def __call__(self, x, t, cond=None):
+        temb = jax.nn.swish(nn.Dense(self.features)(
+            jnp.stack([jnp.sin(t * 0.01), jnp.cos(t * 0.01)], axis=-1)))
+        h = nn.Conv(self.features, (3, 3))(x)
+        h = jax.nn.swish(h + temb[:, None, None, :])
+        if cond is not None:
+            c = nn.Dense(self.features)(cond["label"])
+            h = h + c[:, None, None, :]
+        h = nn.Conv(self.features, (3, 3))(jax.nn.swish(h))
+        return nn.Conv(x.shape[-1], (3, 3),
+                       kernel_init=nn.initializers.zeros)(h)
+
+
+def make_trainer(mesh, uncond_prob=0.0, null_cond=None, with_cond=False,
+                 **cfg_kw):
+    model = TinyDenoiser()
+    shape = (1, 8, 8, 3)
+
+    def apply_fn(params, x, t, cond):
+        return model.apply({"params": params}, x, t, cond)
+
+    def init_fn(key):
+        cond = {"label": jnp.zeros((1, 4))} if with_cond else None
+        return model.init(key, jnp.zeros(shape), jnp.zeros((1,)),
+                          cond)["params"]
+
+    return DiffusionTrainer(
+        apply_fn=apply_fn, init_fn=init_fn,
+        tx=optax.adam(1e-3),
+        schedule=CosineNoiseSchedule(timesteps=1000),
+        transform=EpsilonPredictionTransform(),
+        mesh=mesh,
+        config=TrainerConfig(uncond_prob=uncond_prob, log_every=5,
+                             normalize=False, weighted_loss=False, **cfg_kw),
+        null_cond=null_cond,
+    )
+
+
+def data_iter(batch=16, with_cond=False, seed=0):
+    rng = np.random.default_rng(seed)
+    while True:
+        x = rng.normal(size=(batch, 8, 8, 3)).astype(np.float32) * 0.1
+        b = {"sample": x}
+        if with_cond:
+            b["cond"] = {"label": rng.normal(size=(batch, 4)).astype(np.float32)}
+        yield b
+
+
+class TestTrainer:
+    def test_state_is_sharded(self, mesh):
+        tr = make_trainer(mesh)
+        kernels = [l for p, l in
+                   jax.tree_util.tree_leaves_with_path(tr.state.params)
+                   if l.ndim >= 2 and l.size >= 2 ** 16]
+        # At least the biggest kernels must actually be sharded on fsdp
+        specs = [l.sharding.spec for l in kernels]
+        assert any("fsdp" in str(s) for s in specs) or not kernels
+        # step/rng replicated
+        assert tr.state.step.sharding.spec == P()
+
+    def test_loss_decreases(self, mesh):
+        tr = make_trainer(mesh)
+        it = data_iter()
+        hist = tr.fit(it, total_steps=60)
+        assert np.isfinite(hist["final_loss"])
+        assert hist["loss"][-1] < hist["loss"][0]
+
+    def test_ema_tracks_params(self, mesh):
+        tr = make_trainer(mesh)
+        it = data_iter()
+        tr.fit(it, total_steps=10)
+        # After steps, EMA differs from params but has same structure
+        p = jax.tree_util.tree_leaves(tr.state.params)
+        e = jax.tree_util.tree_leaves(tr.state.ema_params)
+        assert len(p) == len(e)
+        assert any(not np.allclose(np.asarray(a), np.asarray(b))
+                   for a, b in zip(p, e))
+
+    def test_conditional_with_cfg_dropout(self, mesh):
+        null = {"label": jnp.zeros((1, 4), jnp.float32)}
+        tr = make_trainer(mesh, uncond_prob=0.5, null_cond=null,
+                          with_cond=True)
+        it = data_iter(with_cond=True)
+        hist = tr.fit(it, total_steps=10)
+        assert np.isfinite(hist["final_loss"])
+
+    def test_recovery_restores_best_state(self, mesh):
+        tr = make_trainer(mesh)
+        it = data_iter()
+        tr.fit(it, total_steps=10)
+        assert tr.best_state is not None
+        before = jax.device_get(tr.best_state.params)
+        tr._recover(float("nan"))
+        after = jax.device_get(tr.state.params)
+        chex_equal = jax.tree_util.tree_all(jax.tree_util.tree_map(
+            lambda a, b: np.allclose(a, b), before, after))
+        assert chex_equal
+
+    def test_get_params_selects_ema(self, mesh):
+        tr = make_trainer(mesh)
+        it = data_iter()
+        tr.fit(it, total_steps=6)
+        assert tr.get_params(use_ema=True) is tr.state.ema_params
+        assert tr.get_params(use_ema=False) is tr.state.params
