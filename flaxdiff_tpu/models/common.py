@@ -245,7 +245,7 @@ class FusedGroupNormSiLU(nn.Module):
     """
 
     groups: int = 8
-    eps: float = 1e-5
+    eps: float = 1e-6
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:

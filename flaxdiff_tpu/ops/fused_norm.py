@@ -1,36 +1,81 @@
-"""Fused GroupNorm + SiLU Pallas kernel (resblock prologue).
+"""Fused GroupNorm + SiLU Pallas kernels (resblock prologue).
 
 The reference runs GroupNorm and SiLU as separate XLA ops
-(reference flaxdiff/models/common.py:283-334); on TPU the two are
-HBM-bandwidth bound, so fusing the normalization statistics, affine and
-activation into one VMEM pass saves a round trip. Falls back to XLA when
-not on TPU or the sample doesn't fit VMEM.
+(reference flaxdiff/models/common.py:283-334); on TPU the chain is
+HBM-bandwidth bound, so the affine + activation are fused into the
+normalization pass. Two tiled kernels (stats, then normalize) so samples
+of any spatial size stream through VMEM in blocks:
+
+- stats kernel: per (sample, hw-block) partial group sums/sumsqs, computed
+  with 2D matmuls against a [C, G] membership mask (Mosaic can't reshape
+  across the lane dim, and the mask matmul rides the MXU).
+- normalize kernel: (x - mean) * rstd * scale + bias (+ SiLU) per block.
+
+Backward recomputes through the XLA path (correct gradients; dedicated
+backward kernel is a later optimization). Falls back to XLA off-TPU.
 """
 from __future__ import annotations
 
 import functools
-import math
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-# Per-sample VMEM budget for the fused kernel (bytes); larger samples fall
-# back to XLA which tiles fine on its own.
-_VMEM_SAMPLE_BYTES = 4 * 1024 * 1024
+# Target f32 bytes for one [block_hw, C] input block in VMEM. The kernels
+# keep ~3 block-sized f32 temporaries live, so 1 MiB blocks stay well
+# under the ~16 MiB scoped-VMEM limit.
+_BLOCK_BYTES = 1 << 20
 
 
-def _gn_silu_kernel(x_ref, scale_ref, bias_ref, o_ref, *, groups: int,
-                    eps: float, apply_silu: bool):
-    x = x_ref[0].astype(jnp.float32)  # [HW, C]
-    hw, c = x.shape
+def _block_hw(hw: int, c: int) -> int:
+    rows = max(8, _BLOCK_BYTES // (4 * c))
+    rows = min(rows, hw)
+    # Round to a sublane-friendly multiple of 8.
+    return max(8, (rows // 8) * 8)
+
+
+def _member_mask(c: int, groups: int) -> jnp.ndarray:
     cg = c // groups
-    xg = x.reshape(hw, groups, cg)
-    mean = jnp.mean(xg, axis=(0, 2), keepdims=True)
-    var = jnp.mean((xg - mean) ** 2, axis=(0, 2), keepdims=True)
-    xn = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(hw, c)
-    out = xn * scale_ref[...].astype(jnp.float32) + bias_ref[...].astype(jnp.float32)
+    ch = jax.lax.broadcasted_iota(jnp.int32, (c, groups), 0)
+    gi = jax.lax.broadcasted_iota(jnp.int32, (c, groups), 1)
+    return (ch // cg == gi).astype(jnp.float32)
+
+
+def _gn_stats_kernel(x_ref, o_ref, *, groups: int, hw: int, block_hw: int):
+    i = pl.program_id(1)
+    x = x_ref[0].astype(jnp.float32)  # [block_hw, C]
+    c = x.shape[1]
+    valid = (i * block_hw
+             + jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)) < hw
+    x = jnp.where(valid, x, 0.0)
+    member = _member_mask(c, groups)
+    # HIGHEST precision: tiny [1,C]x[C,G] matmuls, but bf16 MXU rounding
+    # here would corrupt the statistics.
+    dot = functools.partial(jax.lax.dot_general,
+                            preferred_element_type=jnp.float32,
+                            precision=jax.lax.Precision.HIGHEST)
+    colsum = jnp.sum(x, axis=0, keepdims=True)            # [1, C]
+    gsum = dot(colsum, member, (((1,), (0,)), ((), ())))  # [1, G]
+    # Shifted second moment: accumulate sum((x - block_mean)^2) instead of
+    # sum(x^2), so large-mean activations don't cancel catastrophically in
+    # the E[x^2]-E[x]^2 finalize (blocks are Welford-merged there).
+    nb = jnp.minimum(block_hw, hw - i * block_hw).astype(jnp.float32)
+    mean_g = gsum / (nb * (c // groups))                   # [1, G]
+    mean_c = dot(mean_g, member, (((1,), (1,)), ((), ()))) # [1, C]
+    xc = jnp.where(valid, x - mean_c, 0.0)
+    colsq = jnp.sum(xc * xc, axis=0, keepdims=True)        # [1, C]
+    gsq = dot(colsq, member, (((1,), (0,)), ((), ())))     # [1, G]
+    o_ref[0, 0] = jnp.concatenate([gsum, gsq], axis=0)     # [2, G]
+
+
+def _gn_norm_kernel(x_ref, mean_ref, rstd_ref, scale_ref, bias_ref, o_ref, *,
+                    apply_silu: bool):
+    x = x_ref[0].astype(jnp.float32)  # [block_hw, C]
+    out = (x - mean_ref[0].astype(jnp.float32)) \
+        * rstd_ref[0].astype(jnp.float32)
+    out = out * scale_ref[...].astype(jnp.float32) \
+        + bias_ref[...].astype(jnp.float32)
     if apply_silu:
         out = out * jax.nn.sigmoid(out)
     o_ref[0] = out.astype(o_ref.dtype)
@@ -56,28 +101,60 @@ def _impl(x: jax.Array, scale: jax.Array, bias: jax.Array,
     assert c % groups == 0, f"channels {c} not divisible by groups {groups}"
     orig_shape = x.shape
     b = x.shape[0]
-    sample_bytes = math.prod(x.shape[1:]) * 4
 
     on_tpu = jax.devices()[0].platform == "tpu"
-    if not force_pallas and (not (on_tpu or interpret)
-                             or sample_bytes > _VMEM_SAMPLE_BYTES):
+    if not force_pallas and not (on_tpu or interpret):
         return _xla_groupnorm_silu(x, scale, bias, groups, eps, apply_silu)
 
     xr = x.reshape(b, -1, c)
     hw = xr.shape[1]
+    blk = _block_hw(hw, c)
+    nblk = pl.cdiv(hw, blk)
+
+    # Pass 1: per-block partial group sums -> [B, nblk, 2, G].
+    sums = pl.pallas_call(
+        functools.partial(_gn_stats_kernel, groups=groups, hw=hw,
+                          block_hw=blk),
+        grid=(b, nblk),
+        in_specs=[pl.BlockSpec((1, blk, c), lambda i, j: (i, j, 0))],
+        out_specs=pl.BlockSpec((1, 1, 2, groups), lambda i, j: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, nblk, 2, groups), jnp.float32),
+        interpret=interpret,
+    )(xr)
+
+    # Finalize on XLA (O(B*G)): Welford merge of the per-block
+    # (sum, shifted-M2) pairs — var stays stable for large-mean inputs.
+    cg = c // groups
+    n_rows = jnp.minimum(blk, hw - blk * jnp.arange(nblk)).astype(jnp.float32)
+    n_b = n_rows[None, :, None] * cg            # [1, nblk, 1] counts
+    n = float(hw * cg)
+    gsum_b = sums[:, :, 0]                      # [B, nblk, G]
+    m2_b = sums[:, :, 1]                        # [B, nblk, G]
+    mean_g = jnp.sum(gsum_b, axis=1) / n        # [B, G]
+    mean_b = gsum_b / n_b
+    m2 = jnp.sum(m2_b + n_b * (mean_b - mean_g[:, None, :]) ** 2, axis=1)
+    var_g = m2 / n
+    rstd_g = jax.lax.rsqrt(jnp.maximum(var_g, 0.0) + eps)
+    # [B, 1, C] so the per-sample block equals the array in the minor two
+    # dims (Pallas TPU block-shape rule).
+    mean_c = jnp.repeat(mean_g, c // groups, axis=-1)[:, None, :]
+    rstd_c = jnp.repeat(rstd_g, c // groups, axis=-1)[:, None, :]
+
+    # Pass 2: normalize + affine + SiLU per block.
     out = pl.pallas_call(
-        functools.partial(_gn_silu_kernel, groups=groups, eps=eps,
-                          apply_silu=apply_silu),
-        grid=(b,),
+        functools.partial(_gn_norm_kernel, apply_silu=apply_silu),
+        grid=(b, nblk),
         in_specs=[
-            pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)),
-            pl.BlockSpec((c,), lambda i: (0,)),
-            pl.BlockSpec((c,), lambda i: (0,)),
+            pl.BlockSpec((1, blk, c), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, 1, c), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, c), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, c), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, c), lambda i, j: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0)),
+        out_specs=pl.BlockSpec((1, blk, c), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b, hw, c), x.dtype),
         interpret=interpret,
-    )(xr, scale, bias)
+    )(xr, mean_c, rstd_c, scale.reshape(1, c), bias.reshape(1, c))
     return out.reshape(orig_shape)
 
 
@@ -110,7 +187,7 @@ _fused_gn_silu.defvjp(_gn_fwd, _gn_bwd)
 
 
 def fused_groupnorm_silu(x: jax.Array, scale: jax.Array, bias: jax.Array,
-                         groups: int = 8, eps: float = 1e-5,
+                         groups: int = 8, eps: float = 1e-6,
                          apply_silu: bool = True,
                          interpret: bool = False,
                          force_pallas: bool = False) -> jax.Array:

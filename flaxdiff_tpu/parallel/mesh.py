@@ -96,8 +96,7 @@ def mesh_shape_for(mesh: Mesh) -> Dict[str, int]:
     return dict(zip(mesh.axis_names, mesh.devices.shape))
 
 
-def local_batch_size(mesh: Mesh, global_batch_size: int,
-                     axis: str = AXIS_DATA) -> int:
+def local_batch_size(global_batch_size: int) -> int:
     """Per-process batch size for host-sharded input pipelines
     (reference: data/dataloaders.py:297 batch_size // process_count)."""
     if global_batch_size % jax.process_count() != 0:

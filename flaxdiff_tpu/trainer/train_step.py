@@ -40,7 +40,6 @@ class TrainStepConfig:
     ema_decay: float = 0.999
     normalize: bool = True             # (x-127.5)/127.5 inside the step
     weighted_loss: bool = True         # schedule loss weights (P2 / EDM)
-    clip_grad_handled_by_tx: bool = True
 
 
 def make_train_step(

@@ -26,7 +26,6 @@ from ..parallel.mesh import batch_spec
 from ..predictors import PredictionTransform
 from ..schedulers.common import NoiseSchedule
 from ..typing import Policy, PyTree
-from ..utils import convert_to_global_tree
 from .train_state import TrainState
 from .train_step import TrainStepConfig, make_train_step
 
@@ -95,10 +94,7 @@ class DiffusionTrainer:
             self.state = jax.jit(
                 create_state, out_shardings=self.state_shardings)(key)
 
-        self._batch_sharding_cache: Dict[Any, Any] = {}
-        bspec = batch_spec(mesh)
-        self._batch_sharding = NamedSharding(mesh, bspec)
-        self._batch_axis = bspec
+        self._batch_axis = batch_spec(mesh)
 
         self._step = jax.jit(
             step_fn,
@@ -137,6 +133,7 @@ class DiffusionTrainer:
         """
         cfg = self.config
         losses, log_t0 = [], time.perf_counter()
+        steps_in_window = 0
         pending_loss = None
         history: Dict[str, Any] = {"steps": [], "loss": [], "imgs_per_sec": []}
 
@@ -144,17 +141,21 @@ class DiffusionTrainer:
             batch = next(data)
             global_batch = self.put_batch(batch)
             pending_loss = self.train_step(global_batch)
+            steps_in_window += 1
 
             if (i + 1) % cfg.log_every == 0 or i == total_steps - 1:
                 loss = float(pending_loss)
                 if not np.isfinite(loss) or loss <= cfg.abnormal_loss_floor:
                     self._recover(loss)
+                    steps_in_window = 0
+                    log_t0 = time.perf_counter()
                     continue
                 losses.append(loss)
                 dt = time.perf_counter() - log_t0
                 bsz = jax.tree_util.tree_leaves(batch)[0].shape[0] \
                     * jax.process_count()
-                ips = cfg.log_every * bsz / max(dt, 1e-9)
+                ips = steps_in_window * bsz / max(dt, 1e-9)
+                steps_in_window = 0
                 history["steps"].append(i + 1)
                 history["loss"].append(loss)
                 history["imgs_per_sec"].append(ips)

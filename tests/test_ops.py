@@ -56,3 +56,35 @@ def test_fused_groupnorm_matches_flax_groupnorm():
         x, params["params"]["scale"], params["params"]["bias"], groups=4,
         interpret=True, force_pallas=True)
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_groupnorm_multiblock_partial(monkeypatch):
+    """Force nblk > 1 with a non-multiple-of-8 hw: exercises the row mask,
+    per-block partial sums, and the Welford merge in the finalize."""
+    import flaxdiff_tpu.ops.fused_norm as fn
+    monkeypatch.setattr(fn, "_BLOCK_BYTES", 8 * 16 * 4)  # 8-row blocks
+    key = jax.random.PRNGKey(7)
+    x = jax.random.normal(key, (2, 10, 10, 16))  # hw=100: 13 blocks, last partial
+    scale = jnp.ones((16,))
+    bias = jnp.zeros((16,))
+    out = fn.fused_groupnorm_silu(x, scale, bias, groups=4, interpret=True,
+                                  force_pallas=True)
+    ref = fn._xla_groupnorm_silu(x, scale, bias, 4, 1e-5, True)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_groupnorm_large_mean_stable(monkeypatch):
+    """Large-mean activations: one-pass E[x^2]-E[x]^2 would cancel; the
+    shifted per-block second moment must not."""
+    import flaxdiff_tpu.ops.fused_norm as fn
+    monkeypatch.setattr(fn, "_BLOCK_BYTES", 8 * 16 * 4)
+    key = jax.random.PRNGKey(8)
+    x = 1000.0 + jax.random.normal(key, (1, 16, 16, 16)) * 0.1
+    scale = jnp.ones((16,))
+    bias = jnp.zeros((16,))
+    out = fn.fused_groupnorm_silu(x, scale, bias, groups=4, interpret=True,
+                                  force_pallas=True)
+    ref = fn._xla_groupnorm_silu(
+        x.astype(jnp.float64) if jax.config.jax_enable_x64 else x,
+        scale, bias, 4, 1e-5, True)
+    np.testing.assert_allclose(out, ref, rtol=5e-2, atol=5e-2)
