@@ -1,4 +1,15 @@
 """Model families (capability parity: reference flaxdiff/models/)."""
-from . import common
+from . import common, sfc
 from .attention import AttentionLayer, BasicTransformerBlock, TransformerBlock
+from .dit import DiTBlock, SimpleDiT
 from .unet import Unet
+from .uvit import SimpleUDiT, UViT
+from .vit_common import (
+    AdaLNParams,
+    AdaLNZero,
+    PatchEmbedding,
+    PositionalEncoding,
+    RoPEAttention,
+    apply_rope,
+    rope_frequencies,
+)

@@ -1,0 +1,198 @@
+"""Space-filling-curve patch serialization (Hilbert / zigzag) + 2D sin-cos.
+
+Capability parity with reference flaxdiff/models/hilbert.py:12-370
+(hilbert_indices, inverse_permutation, patchify/unpatchify,
+hilbert_patchify/hilbert_unpatchify, zigzag_*, build_2d_sincos_pos_embed).
+
+TPU-first design: every permutation is a host-side numpy computation done
+once at trace time (the grid shape is static under jit), so inside the XLA
+program the reorder is a single `jnp.take` gather with a constant index
+vector — no scalar loops, no dynamic shapes, fully fusable. The reference
+computes Hilbert coordinates with a scalar per-index Python loop
+(hilbert.py:50-85); here the decode is vectorized over all indices at once.
+"""
+from __future__ import annotations
+
+import math
+from functools import lru_cache
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Index math (host-side numpy, cached per grid shape)
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=64)
+def _hilbert_xy(order: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized Hilbert decode: curve index d -> (x, y) on a 2^order square.
+
+    Classic bit-twiddling decode (cf. Wikipedia "Hilbert curve", d2xy),
+    vectorized over all n*n indices simultaneously.
+    """
+    n = 1 << order
+    d = np.arange(n * n, dtype=np.int64)
+    x = np.zeros_like(d)
+    y = np.zeros_like(d)
+    t = d.copy()
+    s = 1
+    while s < n:
+        rx = 1 & (t >> 1)
+        ry = 1 & (t ^ rx)
+        # Rotate the quadrant where ry == 0 (mirror when rx == 1).
+        rot = ry == 0
+        flip = rot & (rx == 1)
+        xf = np.where(flip, s - 1 - x, x)
+        yf = np.where(flip, s - 1 - y, y)
+        x = np.where(rot, yf, xf)
+        y = np.where(rot, xf, yf)
+        x = x + s * rx
+        y = y + s * ry
+        t >>= 2
+        s <<= 1
+    return x, y
+
+
+@lru_cache(maxsize=64)
+def hilbert_indices(h: int, w: int) -> np.ndarray:
+    """Scan-order permutation for an h x w grid: result[k] is the row-major
+    index of the k-th token along the Hilbert curve.
+
+    Rectangular / non-power-of-2 grids are handled by walking the curve on
+    the smallest enclosing 2^m square and keeping only in-grid points
+    (reference hilbert.py:87-130 does the same overscan+filter).
+    """
+    if h <= 0 or w <= 0:
+        raise ValueError(f"grid must be positive, got {h}x{w}")
+    order = max(1, math.ceil(math.log2(max(h, w))))
+    x, y = _hilbert_xy(order)
+    keep = (x < w) & (y < h)
+    return (y[keep] * w + x[keep]).astype(np.int32)
+
+
+@lru_cache(maxsize=64)
+def zigzag_indices(h: int, w: int) -> np.ndarray:
+    """Serpentine (boustrophedon) scan: even rows left->right, odd rows
+    right->left (reference hilbert.py:248-269, ZigMa-style)."""
+    rows = np.arange(h)[:, None] * w + np.arange(w)[None, :]
+    rows[1::2] = rows[1::2, ::-1]
+    return rows.reshape(-1).astype(np.int32)
+
+
+def inverse_permutation(idx: np.ndarray, total_size: int | None = None) -> np.ndarray:
+    """inv such that inv[idx[k]] = k (reference hilbert.py:132-158)."""
+    idx = np.asarray(idx)
+    n = total_size if total_size is not None else idx.shape[0]
+    inv = np.zeros(n, dtype=np.int32)
+    inv[idx] = np.arange(idx.shape[0], dtype=np.int32)
+    return inv
+
+
+# ---------------------------------------------------------------------------
+# Patchify / unpatchify (pure reshapes — XLA folds these into layout ops)
+# ---------------------------------------------------------------------------
+
+def patchify(x: jax.Array, patch_size: int) -> jax.Array:
+    """[B, H, W, C] -> [B, (H/p)*(W/p), p*p*C] in row-major patch order
+    (reference hilbert.py:162-211)."""
+    b, h, w, c = x.shape
+    p = patch_size
+    x = x.reshape(b, h // p, p, w // p, p, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, (h // p) * (w // p), p * p * c)
+
+
+def unpatchify(tokens: jax.Array, patch_size: int, h: int, w: int,
+               channels: int) -> jax.Array:
+    """Inverse of `patchify` for a known (h, w)."""
+    b = tokens.shape[0]
+    p = patch_size
+    x = tokens.reshape(b, h // p, w // p, p, p, channels)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h, w, channels)
+
+
+def unpatchify_square(tokens: jax.Array, channels: int = 3) -> jax.Array:
+    """Reference-compatible unpatchify that infers a square grid from the
+    token count (reference vit_common.py:10-17)."""
+    n = tokens.shape[1]
+    side = int(round(math.sqrt(n)))
+    p = int(round(math.sqrt(tokens.shape[2] // channels)))
+    if side * side != n or p * p * channels != tokens.shape[2]:
+        raise ValueError(f"cannot infer square grid from {tokens.shape}")
+    return unpatchify(tokens, p, side * p, side * p, channels)
+
+
+# ---------------------------------------------------------------------------
+# Scan-order patchify (gather) / unpatchify (gather by inverse)
+# ---------------------------------------------------------------------------
+
+def sfc_patchify(x: jax.Array, patch_size: int,
+                 indices: np.ndarray) -> Tuple[jax.Array, np.ndarray]:
+    """Extract raw patches and reorder them into the given scan order.
+
+    Returns (patches [B, N, p*p*C], inverse permutation) — the inverse is what
+    `sfc_unpatchify` needs to undo the reorder (reference hilbert.py:213-246).
+    """
+    tokens = patchify(x, patch_size)
+    inv = inverse_permutation(indices, tokens.shape[1])
+    return jnp.take(tokens, jnp.asarray(indices), axis=1), inv
+
+
+def sfc_unpatchify(tokens: jax.Array, inv_idx: np.ndarray, patch_size: int,
+                   h: int, w: int, channels: int) -> jax.Array:
+    """Restore row-major order via the inverse permutation, then unpatchify.
+
+    jit-compatible: the 'scatter' is expressed as a gather with the static
+    inverse index (reference hilbert.py:302-370 builds a masked scatter; a
+    constant-index gather is the cheaper XLA-native form).
+    """
+    tokens = jnp.take(tokens, jnp.asarray(inv_idx), axis=1)
+    return unpatchify(tokens, patch_size, h, w, channels)
+
+
+def hilbert_patchify(x: jax.Array, patch_size: int) -> Tuple[jax.Array, np.ndarray]:
+    b, h, w, c = x.shape
+    return sfc_patchify(x, patch_size, hilbert_indices(h // patch_size, w // patch_size))
+
+
+def hilbert_unpatchify(tokens: jax.Array, inv_idx: np.ndarray, patch_size: int,
+                       h: int, w: int, channels: int) -> jax.Array:
+    return sfc_unpatchify(tokens, inv_idx, patch_size, h, w, channels)
+
+
+def zigzag_patchify(x: jax.Array, patch_size: int) -> Tuple[jax.Array, np.ndarray]:
+    b, h, w, c = x.shape
+    return sfc_patchify(x, patch_size, zigzag_indices(h // patch_size, w // patch_size))
+
+
+def zigzag_unpatchify(tokens: jax.Array, inv_idx: np.ndarray, patch_size: int,
+                      h: int, w: int, channels: int) -> jax.Array:
+    return sfc_unpatchify(tokens, inv_idx, patch_size, h, w, channels)
+
+
+# ---------------------------------------------------------------------------
+# 2D sin-cos positional embedding (MAE-style)
+# ---------------------------------------------------------------------------
+
+def _sincos_1d(dim: int, positions: np.ndarray) -> np.ndarray:
+    """[len(positions), dim] standard transformer sin-cos table."""
+    assert dim % 2 == 0, f"1d sincos dim must be even, got {dim}"
+    omega = 1.0 / (10000.0 ** (np.arange(dim // 2, dtype=np.float64) / (dim / 2.0)))
+    out = np.einsum("p,f->pf", positions.astype(np.float64), omega)
+    return np.concatenate([np.sin(out), np.cos(out)], axis=1)
+
+
+@lru_cache(maxsize=64)
+def build_2d_sincos_pos_embed(embed_dim: int, h: int, w: int) -> np.ndarray:
+    """[h*w, embed_dim] fixed MAE-style 2D embedding, row-major
+    (reference hilbert.py:12-45): half the channels encode the row, half
+    the column."""
+    assert embed_dim % 4 == 0, f"2d sincos dim must be divisible by 4, got {embed_dim}"
+    gy, gx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    emb_h = _sincos_1d(embed_dim // 2, gy.reshape(-1))
+    emb_w = _sincos_1d(embed_dim // 2, gx.reshape(-1))
+    return np.concatenate([emb_h, emb_w], axis=1).astype(np.float32)

@@ -1,0 +1,234 @@
+"""U-shaped vision transformers: UViT and SimpleUDiT.
+
+Capability parity with reference flaxdiff/models/simple_vit.py:18-446:
+- UViT: patchify + learned pos-enc, time token and text tokens CONCATENATED
+  to the sequence, symmetric down/mid/up TransformerBlocks with skip concat
+  + Dense fuse, zero-init final projection, optional residual conv output
+  stage, optional Hilbert scan order.
+- SimpleUDiT: the same U shape built from DiTBlocks (RoPE + AdaLN-Zero),
+  conditioning = time embedding + mean-pooled projected text.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..typing import Dtype
+from .attention import TransformerBlock
+from .common import ConvLayer, FourierEmbedding, TimeProjection
+from .dit import DiTBlock
+from .sfc import (
+    hilbert_indices,
+    sfc_patchify,
+    sfc_unpatchify,
+    unpatchify,
+    zigzag_indices,
+)
+from .vit_common import (
+    PatchEmbedding,
+    PositionalEncoding,
+    identity_rope,
+    rope_frequencies,
+)
+
+
+class UViT(nn.Module):
+    """U-shaped ViT over a token sequence of [patches; time; text]
+    (reference simple_vit.py:18-255)."""
+
+    output_channels: int = 3
+    patch_size: int = 16
+    emb_features: int = 768
+    num_layers: int = 12           # must be even (down/up symmetry)
+    num_heads: int = 12
+    use_projection: bool = False
+    use_self_and_cross: bool = False
+    backend: str = "auto"
+    force_fp32_for_softmax: bool = True
+    activation: Callable = jax.nn.swish
+    dtype: Optional[Dtype] = None
+    precision: Optional[jax.lax.Precision] = None
+    add_residualblock_output: bool = False
+    norm_epsilon: float = 1e-5
+    use_hilbert: bool = False
+    max_image_size: int = 512      # sizes the learned pos-enc table
+
+    @nn.compact
+    def __call__(self, x: jax.Array, temb: jax.Array,
+                 textcontext: Optional[jax.Array] = None) -> jax.Array:
+        if self.num_layers % 2:
+            raise ValueError("num_layers must be even for the U structure")
+        original = x
+        B, H, W, C = x.shape
+        p = self.patch_size
+        hp, wp = H // p, W // p
+        num_patches = hp * wp
+
+        inv_idx = None
+        if self.use_hilbert:
+            raw, inv_idx = sfc_patchify(x, p, hilbert_indices(hp, wp))
+            tokens = nn.Dense(self.emb_features, dtype=self.dtype,
+                              precision=self.precision, name="scan_proj")(raw)
+        else:
+            tokens = PatchEmbedding(
+                patch_size=p, embedding_dim=self.emb_features,
+                dtype=self.dtype, precision=self.precision,
+                name="patch_embed")(x)
+        tokens = PositionalEncoding(
+            max_len=(self.max_image_size // p) ** 2,
+            embedding_dim=self.emb_features, name="pos_enc")(tokens)
+
+        t_emb = FourierEmbedding(features=self.emb_features, name="t_fourier")(temb)
+        t_emb = TimeProjection(features=self.emb_features, name="t_proj")(t_emb)
+        seq = [tokens, t_emb[:, None, :].astype(tokens.dtype)]
+        if textcontext is not None:
+            text = nn.DenseGeneral(self.emb_features, dtype=self.dtype,
+                                   precision=self.precision,
+                                   name="text_proj")(textcontext)
+            seq.append(text.astype(tokens.dtype))
+        h = jnp.concatenate(seq, axis=1)
+
+        block = lambda name: TransformerBlock(
+            heads=self.num_heads,
+            dim_head=self.emb_features // self.num_heads,
+            backend=self.backend, dtype=self.dtype, precision=self.precision,
+            use_projection=self.use_projection,
+            use_self_and_cross=self.use_self_and_cross,
+            force_fp32_for_softmax=self.force_fp32_for_softmax,
+            name=name)
+
+        half = self.num_layers // 2
+        skips = []
+        for i in range(half):
+            h = block(f"down_{i}")(h)
+            skips.append(h)
+        h = block("mid")(h)
+        for i in range(half):
+            h = jnp.concatenate([h, skips.pop()], axis=-1)
+            h = nn.DenseGeneral(self.emb_features, dtype=self.dtype,
+                                precision=self.precision,
+                                name=f"up_fuse_{i}")(h)
+            h = block(f"up_{i}")(h)
+
+        h = nn.LayerNorm(epsilon=self.norm_epsilon, dtype=jnp.float32,
+                         name="final_norm")(h)
+        patch_tokens = h[:, :num_patches, :]
+        patch_tokens = nn.Dense(p * p * self.output_channels,
+                                dtype=jnp.float32,
+                                kernel_init=nn.initializers.zeros,
+                                name="final_proj")(patch_tokens)
+        if inv_idx is not None:
+            img = sfc_unpatchify(patch_tokens, inv_idx, p, H, W,
+                                 self.output_channels)
+        else:
+            img = unpatchify(patch_tokens, p, H, W, self.output_channels)
+
+        if self.add_residualblock_output:
+            # Residual conv refinement over [input; prediction]
+            # (reference simple_vit.py:239-252).
+            img = jnp.concatenate(
+                [original.astype(img.dtype), img], axis=-1)
+            img = ConvLayer("conv", features=64, kernel_size=(3, 3),
+                            strides=(1, 1), dtype=self.dtype,
+                            precision=self.precision, name="final_conv1")(img)
+            img = nn.LayerNorm(epsilon=self.norm_epsilon, dtype=jnp.float32,
+                               name="final_conv_norm")(img)
+            img = self.activation(img)
+            img = ConvLayer("conv", features=self.output_channels,
+                            kernel_size=(3, 3), strides=(1, 1),
+                            dtype=jnp.float32, precision=self.precision,
+                            name="final_conv2")(img)
+        return img
+
+
+class SimpleUDiT(nn.Module):
+    """U-shaped DiT: DiTBlocks with RoPE + AdaLN-Zero in a skip-connected
+    down/mid/up arrangement (reference simple_vit.py:255-446)."""
+
+    output_channels: int = 3
+    patch_size: int = 16
+    emb_features: int = 768
+    num_layers: int = 12           # must be even
+    num_heads: int = 12
+    mlp_ratio: int = 4
+    backend: str = "auto"
+    dtype: Optional[Dtype] = None
+    precision: Optional[jax.lax.Precision] = None
+    force_fp32_for_softmax: bool = True
+    norm_epsilon: float = 1e-5
+    use_hilbert: bool = False
+    use_zigzag: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array, temb: jax.Array,
+                 textcontext: Optional[jax.Array] = None) -> jax.Array:
+        if self.num_layers % 2:
+            raise ValueError("num_layers must be even for the U structure")
+        if self.use_hilbert and self.use_zigzag:
+            raise ValueError("use_hilbert and use_zigzag are mutually exclusive")
+        B, H, W, C = x.shape
+        p = self.patch_size
+        hp, wp = H // p, W // p
+        num_patches = hp * wp
+
+        inv_idx = None
+        if self.use_hilbert or self.use_zigzag:
+            idx = (hilbert_indices(hp, wp) if self.use_hilbert
+                   else zigzag_indices(hp, wp))
+            raw, inv_idx = sfc_patchify(x, p, idx)
+            tokens = nn.Dense(self.emb_features, dtype=self.dtype,
+                              precision=self.precision, name="scan_proj")(raw)
+        else:
+            tokens = PatchEmbedding(
+                patch_size=p, embedding_dim=self.emb_features,
+                dtype=self.dtype, precision=self.precision,
+                name="patch_embed")(x)
+
+        t_emb = FourierEmbedding(features=self.emb_features, name="t_fourier")(temb)
+        t_emb = TimeProjection(features=self.emb_features * self.mlp_ratio,
+                               name="t_proj")(t_emb)
+        t_emb = nn.Dense(self.emb_features, dtype=self.dtype,
+                         precision=self.precision, name="t_out")(t_emb)
+        cond = t_emb
+        if textcontext is not None:
+            text = nn.Dense(self.emb_features, dtype=self.dtype,
+                            precision=self.precision,
+                            name="text_proj")(textcontext)
+            cond = cond + jnp.mean(text, axis=1)
+
+        dim_head = self.emb_features // self.num_heads
+        if self.use_hilbert or self.use_zigzag:
+            freqs = identity_rope(dim_head, num_patches)
+        else:
+            freqs = rope_frequencies(dim_head, num_patches)
+
+        block = lambda name: DiTBlock(
+            features=self.emb_features, num_heads=self.num_heads,
+            mlp_ratio=self.mlp_ratio, backend=self.backend,
+            dtype=self.dtype, precision=self.precision,
+            force_fp32_for_softmax=self.force_fp32_for_softmax,
+            norm_epsilon=self.norm_epsilon, name=name)
+
+        half = self.num_layers // 2
+        skips = []
+        h = tokens
+        for i in range(half):
+            h = block(f"down_{i}")(h, cond, freqs)
+            skips.append(h)
+        h = block("mid")(h, cond, freqs)
+        for i in range(half):
+            h = jnp.concatenate([h, skips.pop()], axis=-1)
+            h = nn.Dense(self.emb_features, dtype=self.dtype,
+                         precision=self.precision, name=f"up_fuse_{i}")(h)
+            h = block(f"up_{i}")(h, cond, freqs)
+
+        h = nn.LayerNorm(epsilon=self.norm_epsilon, dtype=jnp.float32,
+                         name="final_norm")(h)
+        h = nn.Dense(p * p * self.output_channels, dtype=jnp.float32,
+                     kernel_init=nn.initializers.zeros, name="final_proj")(h)
+        if inv_idx is not None:
+            return sfc_unpatchify(h, inv_idx, p, H, W, self.output_channels)
+        return unpatchify(h, p, H, W, self.output_channels)

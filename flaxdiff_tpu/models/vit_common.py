@@ -1,0 +1,200 @@
+"""Shared transformer-model layers: patch embedding, RoPE, AdaLN-Zero.
+
+Capability parity with reference flaxdiff/models/vit_common.py:20-261
+(PatchEmbedding, PositionalEncoding, RotaryEmbedding/RoPEAttention,
+AdaLNZero/AdaLNParams). TPU-first choices:
+
+- RoPE tables are computed from static shapes at trace time and become XLA
+  constants — no max_seq_len precompute/cache or dynamic extension needed
+  (the reference carries a 4096-entry table and a fallback path,
+  vit_common.py:86-117).
+- RoPE is applied in [B, S, H, D] layout directly (the layout DenseGeneral
+  produces and the attention op consumes); no transpose round-trip
+  (the reference permutes b s h d -> b h s d and back, vit_common.py:159-171).
+- Attention goes through the ops-layer dispatcher so the Pallas flash path
+  and the XLA fallback share one call site.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+from ..typing import Dtype
+
+
+class PatchEmbedding(nn.Module):
+    """Non-overlapping conv patchify -> [B, N, D] (reference vit_common.py:20-37)."""
+
+    patch_size: int
+    embedding_dim: int
+    dtype: Optional[Dtype] = None
+    precision: Optional[jax.lax.Precision] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, h, w, c = x.shape
+        p = self.patch_size
+        if h % p or w % p:
+            raise ValueError(f"image {h}x{w} not divisible by patch size {p}")
+        x = nn.Conv(self.embedding_dim, (p, p), strides=(p, p),
+                    dtype=self.dtype, precision=self.precision,
+                    name="proj")(x)
+        return x.reshape(b, -1, self.embedding_dim)
+
+
+class PositionalEncoding(nn.Module):
+    """Learned additive positional table (reference vit_common.py:40-49)."""
+
+    max_len: int
+    embedding_dim: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        pe = self.param("pos_encoding", nn.initializers.normal(stddev=0.02),
+                        (1, self.max_len, self.embedding_dim))
+        n = x.shape[1]
+        if n > self.max_len:
+            raise ValueError(f"sequence {n} exceeds max_len {self.max_len}")
+        return x + pe[:, :n, :].astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Rotary position embedding
+# ---------------------------------------------------------------------------
+
+def rope_frequencies(dim: int, seq_len: int, base: float = 10000.0
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """(cos, sin) tables of shape [seq_len, dim//2]; constant-folded under jit
+    because seq_len/dim are static (reference vit_common.py:86-117)."""
+    if dim % 2:
+        raise ValueError(f"RoPE head dim must be even, got {dim}")
+    inv_freq = 1.0 / (base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def identity_rope(dim: int, seq_len: int) -> Tuple[jax.Array, jax.Array]:
+    """cos=1 / sin=0 tables that make RoPE a no-op — used by non-raster scan
+    orders where sequence index is not a 2D position (reference
+    simple_dit.py:282-284)."""
+    shape = (seq_len, dim // 2)
+    return jnp.ones(shape, jnp.float32), jnp.zeros(shape, jnp.float32)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate-half RoPE on [B, S, H, D] with tables [S, D//2]
+    (reference vit_common.py:56-84)."""
+    cos = jnp.concatenate([cos, cos], axis=-1)[None, :, None, :]
+    sin = jnp.concatenate([sin, sin], axis=-1)[None, :, None, :]
+    half = x.shape[-1] // 2
+    rotated = jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+    return (x * cos + rotated * sin).astype(x.dtype)
+
+
+class RoPEAttention(nn.Module):
+    """Multi-head attention with rotary embeddings on q/k
+    (reference vit_common.py:123-183)."""
+
+    heads: int
+    dim_head: int
+    backend: str = "auto"
+    dtype: Optional[Dtype] = None
+    precision: Optional[jax.lax.Precision] = None
+    use_bias: bool = True
+    force_fp32_for_softmax: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array, context: Optional[jax.Array] = None,
+                 freqs_cis: Optional[Tuple[jax.Array, jax.Array]] = None
+                 ) -> jax.Array:
+        spatial = x.ndim == 4
+        if spatial:
+            b, h, w, c = x.shape
+            x = x.reshape(b, h * w, c)
+        context = x if context is None else context
+        dense = lambda name: nn.DenseGeneral(
+            (self.heads, self.dim_head), use_bias=self.use_bias,
+            dtype=self.dtype, precision=self.precision, name=name)
+        q = dense("to_q")(x)
+        k = dense("to_k")(context)
+        v = dense("to_v")(context)
+        if freqs_cis is None:
+            # Size the default table to the longest sequence so cross-attention
+            # with a longer context gets valid positions for every key.
+            cos, sin = rope_frequencies(
+                self.dim_head, max(q.shape[1], k.shape[1]))
+        else:
+            cos, sin = freqs_cis
+        q = apply_rope(q, cos[: q.shape[1]], sin[: q.shape[1]])
+        k = apply_rope(k, cos[: k.shape[1]], sin[: k.shape[1]])
+        out = dot_product_attention(
+            q, k, v, backend=self.backend,
+            force_fp32_for_softmax=self.force_fp32_for_softmax)
+        out = nn.DenseGeneral(
+            x.shape[-1], axis=(-2, -1), use_bias=self.use_bias,
+            dtype=self.dtype, precision=self.precision, name="to_out")(out)
+        if spatial:
+            out = out.reshape(b, h, w, c)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# AdaLN-Zero conditioning
+# ---------------------------------------------------------------------------
+
+def modulate(x: jax.Array, scale: jax.Array, shift: jax.Array) -> jax.Array:
+    """DiT modulation: x * (1 + scale) + shift."""
+    return x * (1.0 + scale) + shift
+
+
+class AdaLNParams(nn.Module):
+    """Zero-init projection of a conditioning vector to 6 modulation params
+    per feature (scale/shift/gate for attention and MLP paths) —
+    reference vit_common.py:240-261."""
+
+    features: int
+    dtype: Optional[Dtype] = None
+    precision: Optional[jax.lax.Precision] = None
+
+    @nn.compact
+    def __call__(self, conditioning: jax.Array) -> jax.Array:
+        if conditioning.ndim == 2:
+            conditioning = conditioning[:, None, :]
+        return nn.Dense(6 * self.features, dtype=self.dtype,
+                        precision=self.precision,
+                        kernel_init=nn.initializers.zeros,
+                        name="ada_proj")(conditioning)
+
+
+class AdaLNZero(nn.Module):
+    """Norm + modulate in one module: returns (x_attn, gate_attn, x_mlp,
+    gate_mlp) — reference vit_common.py:189-238.
+
+    Note: DiTBlock modulates two separate (pre-attn / pre-MLP) norms via
+    AdaLNParams directly, matching the reference DiT wiring
+    (simple_dit.py:42-95); this single-norm variant is the alternative
+    conditioning surface the reference also exposes.
+    """
+
+    features: int
+    dtype: Optional[Dtype] = None
+    precision: Optional[jax.lax.Precision] = None
+    norm_epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jax.Array, conditioning: jax.Array):
+        params = AdaLNParams(self.features, dtype=self.dtype,
+                             precision=self.precision, name="params")(conditioning)
+        s_mlp, b_mlp, g_mlp, s_attn, b_attn, g_attn = jnp.split(params, 6, axis=-1)
+        s_mlp = jnp.clip(s_mlp, -10.0, 10.0)
+        b_mlp = jnp.clip(b_mlp, -10.0, 10.0)
+        norm_x = nn.LayerNorm(epsilon=self.norm_epsilon, use_scale=False,
+                              use_bias=False, dtype=jnp.float32,
+                              name="norm")(x)
+        return (modulate(norm_x, s_attn, b_attn), g_attn,
+                modulate(norm_x, s_mlp, b_mlp), g_mlp)
