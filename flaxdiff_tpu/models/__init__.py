@@ -2,6 +2,22 @@
 from . import common, sfc
 from .attention import AttentionLayer, BasicTransformerBlock, TransformerBlock
 from .dit import DiTBlock, SimpleDiT
+from .mmdit import (
+    HierarchicalMMDiT,
+    MMAdaLNZero,
+    MMDiTBlock,
+    PatchExpanding,
+    PatchMerging,
+    SimpleMMDiT,
+)
+from .ssm import (
+    BidirectionalS5Layer,
+    HybridSSMAttentionDiT,
+    S5Layer,
+    SpatialFusionConv,
+    SSMDiTBlock,
+    build_block_pattern,
+)
 from .unet import Unet
 from .uvit import SimpleUDiT, UViT
 from .vit_common import (

@@ -16,22 +16,14 @@ import jax
 import jax.numpy as jnp
 
 from ..typing import Dtype
-from .common import FourierEmbedding, TimeProjection
-from .sfc import (
-    build_2d_sincos_pos_embed,
-    hilbert_indices,
-    sfc_patchify,
-    sfc_unpatchify,
-    unpatchify,
-    zigzag_indices,
-)
+from .sfc import sfc_unpatchify, unpatchify
 from .vit_common import (
     AdaLNParams,
-    PatchEmbedding,
     RoPEAttention,
-    identity_rope,
+    ScanPatchEmbed,
+    TimeTextEmbedding,
     modulate,
-    rope_frequencies,
+    scan_rope,
 )
 
 
@@ -112,48 +104,20 @@ class SimpleDiT(nn.Module):
             raise ValueError("use_hilbert and use_zigzag are mutually exclusive")
         B, H, W, C = x.shape
         p = self.patch_size
-        hp, wp = H // p, W // p
-        num_patches = hp * wp
+        num_patches = (H // p) * (W // p)
+        scan_order = ("hilbert" if self.use_hilbert
+                      else "zigzag" if self.use_zigzag else "raster")
 
-        inv_idx = None
-        if self.use_hilbert or self.use_zigzag:
-            idx = (hilbert_indices(hp, wp) if self.use_hilbert
-                   else zigzag_indices(hp, wp))
-            raw, inv_idx = sfc_patchify(x, p, idx)
-            tokens = nn.Dense(self.emb_features, dtype=self.dtype,
-                              precision=self.precision,
-                              name="scan_proj")(raw)
-        else:
-            idx = None
-            tokens = PatchEmbedding(
-                patch_size=p, embedding_dim=self.emb_features,
-                dtype=self.dtype, precision=self.precision,
-                name="patch_embed")(x)
-
-        pos = jnp.asarray(build_2d_sincos_pos_embed(self.emb_features, hp, wp))
-        if idx is not None:
-            pos = pos[jnp.asarray(idx)]
-        tokens = tokens + pos[None].astype(tokens.dtype)
-
-        # Conditioning: time MLP (+ mean-pooled projected text), reference
-        # simple_dit.py:259-270.
-        t_emb = FourierEmbedding(features=self.emb_features, name="t_fourier")(temb)
-        t_emb = TimeProjection(features=self.emb_features * self.mlp_ratio,
-                               name="t_proj")(t_emb)
-        t_emb = nn.Dense(self.emb_features, dtype=self.dtype,
-                         precision=self.precision, name="t_out")(t_emb)
-        cond = t_emb
-        if textcontext is not None:
-            text = nn.Dense(self.emb_features, dtype=self.dtype,
-                            precision=self.precision,
-                            name="text_proj")(textcontext)
-            cond = cond + jnp.mean(text, axis=1)
-
-        dim_head = self.emb_features // self.num_heads
-        if self.use_hilbert or self.use_zigzag:
-            freqs = identity_rope(dim_head, num_patches)
-        else:
-            freqs = rope_frequencies(dim_head, num_patches)
+        tokens, inv_idx = ScanPatchEmbed(
+            patch_size=p, embedding_dim=self.emb_features,
+            scan_order=scan_order, dtype=self.dtype,
+            precision=self.precision, name="embed")(x)
+        cond = TimeTextEmbedding(
+            features=self.emb_features, mlp_ratio=self.mlp_ratio,
+            dtype=self.dtype, precision=self.precision,
+            name="cond")(temb, textcontext)
+        freqs = scan_rope(self.emb_features // self.num_heads, num_patches,
+                          scan_order)
 
         for i in range(self.num_layers):
             tokens = DiTBlock(

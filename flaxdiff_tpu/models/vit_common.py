@@ -24,6 +24,13 @@ import jax.numpy as jnp
 
 from ..ops.attention import dot_product_attention
 from ..typing import Dtype
+from .common import FourierEmbedding, TimeProjection
+from .sfc import (
+    build_2d_sincos_pos_embed,
+    hilbert_indices,
+    sfc_patchify,
+    zigzag_indices,
+)
 
 
 class PatchEmbedding(nn.Module):
@@ -141,6 +148,99 @@ class RoPEAttention(nn.Module):
         if spatial:
             out = out.reshape(b, h, w, c)
         return out
+
+
+# ---------------------------------------------------------------------------
+# Shared embed / conditioning stanzas (used by DiT, U-DiT, hybrid SSM-DiT)
+# ---------------------------------------------------------------------------
+
+def scan_rope(dim_head: int, seq_len: int, scan_order: str
+              ) -> Tuple[jax.Array, jax.Array]:
+    """RoPE tables for a scan order: real frequencies for raster, identity
+    for hilbert/zigzag where sequence index is not a 2D position
+    (reference simple_dit.py:282-284)."""
+    if scan_order == "raster":
+        return rope_frequencies(dim_head, seq_len)
+    return identity_rope(dim_head, seq_len)
+
+
+class ScanPatchEmbed(nn.Module):
+    """Patch embedding with a selectable scan order.
+
+    raster: conv patch embed. hilbert/zigzag: raw patch extraction + Dense
+    (conv patchify doesn't compose with post-conv reordering). Optionally
+    adds the fixed 2D sin-cos table permuted into scan order so every token
+    carries its true 2D position regardless of sequence position.
+
+    Returns (tokens [B,N,D], inv_idx or None) — inv_idx restores row-major
+    order for unpatchify (reference simple_dit.py:219-255).
+    """
+
+    patch_size: int
+    embedding_dim: int
+    scan_order: str = "raster"
+    add_sincos: bool = True
+    dtype: Optional[Dtype] = None
+    precision: Optional[jax.lax.Precision] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array):
+        b, h, w, c = x.shape
+        p = self.patch_size
+        hp, wp = h // p, w // p
+        if self.scan_order == "hilbert":
+            idx = hilbert_indices(hp, wp)
+        elif self.scan_order == "zigzag":
+            idx = zigzag_indices(hp, wp)
+        elif self.scan_order == "raster":
+            idx = None
+        else:
+            raise ValueError(f"unknown scan_order {self.scan_order!r}")
+
+        if idx is not None:
+            raw, inv_idx = sfc_patchify(x, p, idx)
+            tokens = nn.Dense(self.embedding_dim, dtype=self.dtype,
+                              precision=self.precision,
+                              name="scan_proj")(raw)
+        else:
+            inv_idx = None
+            tokens = PatchEmbedding(
+                patch_size=p, embedding_dim=self.embedding_dim,
+                dtype=self.dtype, precision=self.precision,
+                name="patch_embed")(x)
+
+        if self.add_sincos:
+            pos = jnp.asarray(build_2d_sincos_pos_embed(
+                self.embedding_dim, hp, wp))
+            if idx is not None:
+                pos = pos[jnp.asarray(idx)]
+            tokens = tokens + pos[None].astype(tokens.dtype)
+        return tokens, inv_idx
+
+
+class TimeTextEmbedding(nn.Module):
+    """Pooled conditioning vector: Fourier time MLP plus mean-pooled
+    projected text (reference simple_dit.py:259-270)."""
+
+    features: int
+    mlp_ratio: int = 4
+    dtype: Optional[Dtype] = None
+    precision: Optional[jax.lax.Precision] = None
+
+    @nn.compact
+    def __call__(self, temb: jax.Array,
+                 textcontext: Optional[jax.Array] = None) -> jax.Array:
+        t = FourierEmbedding(features=self.features, name="t_fourier")(temb)
+        t = TimeProjection(features=self.features * self.mlp_ratio,
+                           name="t_proj")(t)
+        cond = nn.Dense(self.features, dtype=self.dtype,
+                        precision=self.precision, name="t_out")(t)
+        if textcontext is not None:
+            text = nn.Dense(self.features, dtype=self.dtype,
+                            precision=self.precision,
+                            name="text_proj")(textcontext)
+            cond = cond + jnp.mean(text, axis=1)
+        return cond
 
 
 # ---------------------------------------------------------------------------
