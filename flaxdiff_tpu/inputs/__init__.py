@@ -1,0 +1,27 @@
+"""Conditioning inputs: encoders + input configuration.
+
+Capability parity with reference flaxdiff/inputs/ (encoders.py:8-98,
+__init__.py:16-172): ConditioningEncoder ABC (tokenize + encode, cached
+unconditional), TextEncoder / CLIPTextEncoder, ConditionalInputConfig and
+DiffusionInputConfig (VAE-aware input shapes, jnp.where CFG-dropout splice
+— the reference's correct masking semantics, inputs/__init__.py:122-137,
+not the prefix-splice variant in diffusion_trainer.py:188-190).
+"""
+from .encoders import (
+    CONDITIONAL_ENCODERS_REGISTRY,
+    CLIPTextEncoder,
+    ConditioningEncoder,
+    HashTextEncoder,
+    TextEncoder,
+)
+from .config import ConditionalInputConfig, DiffusionInputConfig
+
+__all__ = [
+    "ConditioningEncoder",
+    "TextEncoder",
+    "CLIPTextEncoder",
+    "HashTextEncoder",
+    "CONDITIONAL_ENCODERS_REGISTRY",
+    "ConditionalInputConfig",
+    "DiffusionInputConfig",
+]

@@ -1,0 +1,144 @@
+"""Input configuration: per-condition config + whole-input config.
+
+Reference flaxdiff/inputs/__init__.py:16-172.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from .encoders import CONDITIONAL_ENCODERS_REGISTRY, ConditioningEncoder
+
+
+@dataclass
+class ConditionalInputConfig:
+    """One conditioning input: encoder + batch key + cached unconditional
+    (reference inputs/__init__.py:16-74)."""
+
+    encoder: ConditioningEncoder
+    conditioning_data_key: Optional[str] = None
+    pretokenized: bool = False
+    unconditional_input: Any = None
+    model_key_override: Optional[str] = None
+    _uncond_cache: Any = field(default=None, repr=False)
+
+    def __post_init__(self):
+        source = (self.unconditional_input
+                  if self.unconditional_input is not None else "")
+        self._uncond_cache = self.encoder([source])
+
+    @property
+    def batch_key(self) -> str:
+        return self.conditioning_data_key or self.encoder.key
+
+    @property
+    def model_key(self) -> str:
+        return self.model_key_override or self.encoder.key
+
+    def __call__(self, batch_data):
+        data = batch_data[self.batch_key]
+        if self.pretokenized:
+            return self.encoder.encode_from_tokens(data)
+        return self.encoder(data)
+
+    def get_unconditional(self):
+        return self._uncond_cache
+
+    def serialize(self) -> Dict[str, Any]:
+        return {
+            "encoder": self.encoder.serialize(),
+            "encoder_key": self.encoder.serialize().get("type",
+                                                        self.encoder.key),
+            "conditioning_data_key": self.conditioning_data_key,
+            "pretokenized": self.pretokenized,
+            "unconditional_input": self.unconditional_input,
+            "model_key_override": self.model_key_override,
+        }
+
+    @staticmethod
+    def deserialize(config: Dict[str, Any]) -> "ConditionalInputConfig":
+        enc_cls = CONDITIONAL_ENCODERS_REGISTRY.get(config["encoder_key"])
+        if enc_cls is None:
+            raise ValueError(f"Unknown encoder type {config['encoder_key']!r}")
+        return ConditionalInputConfig(
+            encoder=enc_cls.deserialize(config["encoder"]),
+            conditioning_data_key=config.get("conditioning_data_key"),
+            pretokenized=config.get("pretokenized", False),
+            unconditional_input=config.get("unconditional_input"),
+            model_key_override=config.get("model_key_override"),
+        )
+
+
+@dataclass
+class DiffusionInputConfig:
+    """Sample key/shape + conditioning list (reference
+    inputs/__init__.py:77-172)."""
+
+    sample_data_key: str
+    sample_data_shape: Tuple[int, ...]
+    conditions: List[ConditionalInputConfig]
+
+    def get_input_shapes(self, autoencoder=None, sample_model_key: str = "x",
+                         time_embeddings_model_key: str = "temb",
+                         ) -> Dict[str, Tuple[int, ...]]:
+        """Per-model-input shapes, dividing spatial dims by the codec's
+        downscale factor for latent diffusion."""
+        if len(self.sample_data_shape) == 3:
+            H, W, C = self.sample_data_shape
+            lead: Tuple[int, ...] = ()
+        elif len(self.sample_data_shape) == 4:
+            T, H, W, C = self.sample_data_shape
+            lead = (T,)
+        else:
+            raise ValueError(
+                f"unsupported sample shape {self.sample_data_shape}")
+        if autoencoder is not None:
+            d = autoencoder.downscale_factor
+            H, W, C = H // d, W // d, autoencoder.latent_channels
+        shapes = {sample_model_key: (*lead, H, W, C),
+                  time_embeddings_model_key: ()}
+        for cond in self.conditions:
+            shapes[cond.model_key] = tuple(cond.get_unconditional()[0].shape)
+        return shapes
+
+    def get_unconditionals(self):
+        return [c.get_unconditional() for c in self.conditions]
+
+    def process_conditioning(self, batch_data,
+                             uncond_mask: Optional[jnp.ndarray] = None):
+        """Encode every condition; where uncond_mask is True, splice in the
+        cached null embedding via jnp.where (CFG dropout)."""
+        results = []
+        for cond in self.conditions:
+            emb = cond(batch_data)
+            if uncond_mask is not None:
+                if uncond_mask.shape[0] != emb.shape[0]:
+                    raise ValueError(
+                        f"uncond_mask batch {uncond_mask.shape[0]} != "
+                        f"embedding batch {emb.shape[0]}")
+                uncond = jnp.asarray(cond.get_unconditional())
+                mask = uncond_mask.reshape(
+                    (emb.shape[0],) + (1,) * (emb.ndim - 1))
+                uncond_b = jnp.broadcast_to(
+                    uncond.astype(emb.dtype), emb.shape)
+                emb = jnp.where(mask, uncond_b, emb)
+            results.append(emb)
+        return results
+
+    def serialize(self) -> Dict[str, Any]:
+        return {
+            "sample_data_key": self.sample_data_key,
+            "sample_data_shape": list(self.sample_data_shape),
+            "conditions": [c.serialize() for c in self.conditions],
+        }
+
+    @staticmethod
+    def deserialize(config: Dict[str, Any]) -> "DiffusionInputConfig":
+        return DiffusionInputConfig(
+            sample_data_key=config["sample_data_key"],
+            sample_data_shape=tuple(config["sample_data_shape"]),
+            conditions=[ConditionalInputConfig.deserialize(c)
+                        for c in config["conditions"]],
+        )

@@ -1,0 +1,184 @@
+"""Conditioning encoders (reference flaxdiff/inputs/encoders.py:8-98).
+
+CLIPTextEncoder wraps the HF Flax CLIP text tower (requires downloadable
+weights); HashTextEncoder is a first-party deterministic offline encoder
+(stable token hashing + fixed-seed embedding table) used for tests and
+air-gapped environments.
+"""
+from __future__ import annotations
+
+import hashlib
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class ConditioningEncoder(ABC):
+    """model + tokenizer pair; __call__ = tokenize then encode."""
+
+    model: Any
+    tokenizer: Any
+
+    @property
+    def key(self) -> str:
+        return "cond"
+
+    def __call__(self, data):
+        return self.encode_from_tokens(self.tokenize(data))
+
+    def encode_from_tokens(self, tokens):
+        out = self.model(input_ids=tokens["input_ids"],
+                         attention_mask=tokens["attention_mask"])
+        return out.last_hidden_state
+
+    def tokenize(self, data):
+        return self.tokenizer(
+            data, padding="max_length",
+            max_length=self.tokenizer.model_max_length,
+            truncation=True, return_tensors="np")
+
+    @abstractmethod
+    def serialize(self) -> Dict[str, Any]:
+        ...
+
+    @staticmethod
+    @abstractmethod
+    def deserialize(config: Dict[str, Any]) -> "ConditioningEncoder":
+        ...
+
+
+@dataclass
+class TextEncoder(ConditioningEncoder):
+    """Text conditioning (batch key 'text')."""
+
+    @property
+    def key(self) -> str:
+        return "text"
+
+
+@dataclass
+class CLIPTextEncoder(TextEncoder):
+    """HF Flax CLIP text tower (reference encoders.py:54-90)."""
+
+    modelname: str = "openai/clip-vit-large-patch14"
+    backend: str = "jax"
+
+    @staticmethod
+    def from_modelname(modelname: str = "openai/clip-vit-large-patch14",
+                       backend: str = "jax") -> "CLIPTextEncoder":
+        try:
+            from transformers import AutoTokenizer, FlaxCLIPTextModel
+            model = FlaxCLIPTextModel.from_pretrained(
+                modelname, dtype=jnp.bfloat16)
+            tokenizer = AutoTokenizer.from_pretrained(modelname)
+        except Exception as e:  # no network / no weights cached
+            raise RuntimeError(
+                f"Could not load CLIP weights for {modelname!r} (offline?). "
+                "Use HashTextEncoder for air-gapped runs.") from e
+        return CLIPTextEncoder(model=model, tokenizer=tokenizer,
+                               modelname=modelname, backend=backend)
+
+    def serialize(self) -> Dict[str, Any]:
+        return {"type": "clip", "modelname": self.modelname,
+                "backend": self.backend}
+
+    @staticmethod
+    def deserialize(config: Dict[str, Any]) -> "CLIPTextEncoder":
+        return CLIPTextEncoder.from_modelname(
+            modelname=config["modelname"], backend=config.get("backend", "jax"))
+
+
+class _HashTokenizer:
+    """Deterministic, vocabulary-free tokenizer: stable md5 word hashing."""
+
+    def __init__(self, vocab_size: int, model_max_length: int):
+        self.vocab_size = vocab_size
+        self.model_max_length = model_max_length
+
+    def _word_id(self, word: str) -> int:
+        h = hashlib.md5(word.encode("utf-8")).digest()
+        # ids 2.. ; 0 = pad, 1 = empty-string marker
+        return 2 + int.from_bytes(h[:4], "little") % (self.vocab_size - 2)
+
+    def __call__(self, data, padding="max_length", max_length=None,
+                 truncation=True, return_tensors="np"):
+        max_length = max_length or self.model_max_length
+        ids = np.zeros((len(data), max_length), dtype=np.int32)
+        mask = np.zeros((len(data), max_length), dtype=np.int32)
+        for i, text in enumerate(data):
+            words = str(text).lower().split()[:max_length] or ["<empty>"]
+            toks = ([1] if words == ["<empty>"]
+                    else [self._word_id(w) for w in words])
+            ids[i, :len(toks)] = toks
+            mask[i, :len(toks)] = 1
+        return {"input_ids": ids, "attention_mask": mask}
+
+
+class _HashEmbedModel:
+    """Fixed-seed embedding table + mask-aware mixing; deterministic and
+    dependency-free. Output mimics a text tower's last_hidden_state."""
+
+    class _Out:
+        def __init__(self, h):
+            self.last_hidden_state = h
+
+    def __init__(self, vocab_size: int, features: int, seed: int = 0):
+        self.features = features
+        key = jax.random.PRNGKey(seed)
+        # Unit-scale rows: real text towers emit O(1) hidden states; a weak
+        # table makes conditioning signals untrainably faint downstream.
+        self.table = jax.random.normal(key, (vocab_size, features),
+                                       dtype=jnp.float32)
+
+    def __call__(self, input_ids, attention_mask):
+        emb = jnp.take(self.table, jnp.asarray(input_ids), axis=0)
+        mask = jnp.asarray(attention_mask)[..., None].astype(emb.dtype)
+        # simple causal-free mixing: token embedding + masked mean context
+        ctx = jnp.sum(emb * mask, axis=1, keepdims=True) / (
+            jnp.sum(mask, axis=1, keepdims=True) + 1e-6)
+        return self._Out(emb * mask + 0.1 * ctx)
+
+
+@dataclass
+class HashTextEncoder(TextEncoder):
+    """Offline deterministic text encoder (no downloads, no params to train).
+
+    Not a semantic model — it gives distinct, stable embeddings per word so
+    conditioning plumbing (CFG masks, caching, serialization) is exercisable
+    anywhere.
+    """
+
+    vocab_size: int = 4096
+    features: int = 64
+    max_length: int = 77
+
+    @staticmethod
+    def create(vocab_size: int = 4096, features: int = 64,
+               max_length: int = 77) -> "HashTextEncoder":
+        return HashTextEncoder(
+            model=_HashEmbedModel(vocab_size, features),
+            tokenizer=_HashTokenizer(vocab_size, max_length),
+            vocab_size=vocab_size, features=features, max_length=max_length)
+
+    def serialize(self) -> Dict[str, Any]:
+        return {"type": "hash", "vocab_size": self.vocab_size,
+                "features": self.features, "max_length": self.max_length}
+
+    @staticmethod
+    def deserialize(config: Dict[str, Any]) -> "HashTextEncoder":
+        return HashTextEncoder.create(
+            vocab_size=config["vocab_size"], features=config["features"],
+            max_length=config["max_length"])
+
+
+CONDITIONAL_ENCODERS_REGISTRY: Dict[str, Any] = {
+    "clip": CLIPTextEncoder,
+    "hash": HashTextEncoder,
+    # reference keys encoders by batch key 'text' (encoders.py:96-98)
+    "text": CLIPTextEncoder,
+}

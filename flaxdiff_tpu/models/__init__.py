@@ -1,6 +1,13 @@
 """Model families (capability parity: reference flaxdiff/models/)."""
 from . import common, sfc
 from .attention import AttentionLayer, BasicTransformerBlock, TransformerBlock
+from .autoencoder import (
+    AUTOENCODER_REGISTRY,
+    AutoEncoder,
+    IdentityAutoEncoder,
+    KLAutoEncoder,
+    StableDiffusionVAE,
+)
 from .dit import DiTBlock, SimpleDiT
 from .mmdit import (
     HierarchicalMMDiT,

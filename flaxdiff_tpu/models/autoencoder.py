@@ -1,0 +1,402 @@
+"""Autoencoders for latent diffusion.
+
+Capability parity with reference flaxdiff/models/autoencoder/
+(autoencoder.py:11-160 AutoEncoder ABC with video flattening;
+diffusers.py:14-153 StableDiffusionVAE wrapper; simple_autoenc.py stub).
+Differences by design:
+
+- The KL VAE here is FIRST-PARTY Flax (encoder/decoder resnet stacks,
+  reparameterized sampling, scaling factor) rather than a wrapper over the
+  diffusers pipeline — the reference's `SimpleAutoEncoder` was an
+  unimplemented placeholder returning zeros (simple_autoenc.py:25-57); this
+  one trains.
+- `StableDiffusionVAE` remains available but is gated on the optional
+  `diffusers` dependency (not installed in this environment).
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..typing import Dtype, PyTree
+from .common import Downsample, ResidualBlock, Upsample
+
+
+class AutoEncoder(ABC):
+    """Interface every latent-diffusion codec implements.
+
+    `encode`/`decode` auto-flatten video tensors [B, T, H, W, C] to frame
+    batches and restore the temporal axis (reference autoencoder.py:48-117).
+    """
+
+    @abstractmethod
+    def __encode__(self, x: jax.Array, key: Optional[jax.Array] = None,
+                   **kwargs) -> jax.Array:
+        ...
+
+    @abstractmethod
+    def __decode__(self, z: jax.Array, key: Optional[jax.Array] = None,
+                   **kwargs) -> jax.Array:
+        ...
+
+    def _flat_apply(self, fn, x, **kwargs):
+        if x.ndim == 5:
+            b, t = x.shape[:2]
+            out = fn(x.reshape(-1, *x.shape[2:]), **kwargs)
+            return out.reshape(b, t, *out.shape[1:])
+        return fn(x, **kwargs)
+
+    def encode(self, x: jax.Array, key: Optional[jax.Array] = None,
+               **kwargs) -> jax.Array:
+        return self._flat_apply(self.__encode__, x, key=key, **kwargs)
+
+    def decode(self, z: jax.Array, key: Optional[jax.Array] = None,
+               **kwargs) -> jax.Array:
+        return self._flat_apply(self.__decode__, z, key=key, **kwargs)
+
+    def __call__(self, x: jax.Array, key: Optional[jax.Array] = None,
+                 **kwargs) -> jax.Array:
+        if key is not None:
+            ekey, dkey = jax.random.split(key)
+        else:
+            ekey = dkey = None
+        return self.decode(self.encode(x, key=ekey, **kwargs), key=dkey, **kwargs)
+
+    @property
+    @abstractmethod
+    def downscale_factor(self) -> int:
+        ...
+
+    @property
+    @abstractmethod
+    def latent_channels(self) -> int:
+        ...
+
+    @property
+    @abstractmethod
+    def name(self) -> str:
+        ...
+
+    @abstractmethod
+    def serialize(self) -> Dict[str, Any]:
+        ...
+
+
+class IdentityAutoEncoder(AutoEncoder):
+    """Pixel-space no-op codec (downscale 1) so pixel and latent diffusion
+    share one trainer code path."""
+
+    def __init__(self, channels: int = 3):
+        self._channels = channels
+
+    def __encode__(self, x, key=None, **kwargs):
+        return x
+
+    def __decode__(self, z, key=None, **kwargs):
+        return z
+
+    @property
+    def downscale_factor(self) -> int:
+        return 1
+
+    @property
+    def latent_channels(self) -> int:
+        return self._channels
+
+    @property
+    def name(self) -> str:
+        return "identity"
+
+    def serialize(self) -> Dict[str, Any]:
+        return {"channels": self._channels}
+
+
+# ---------------------------------------------------------------------------
+# First-party KL VAE
+# ---------------------------------------------------------------------------
+
+def _res_block(features: int, norm_groups: int, dtype, name: str):
+    """Shared resblock (temb=None path) — routes through the fused Pallas
+    GroupNorm+SiLU kernel like the rest of the model zoo."""
+    return ResidualBlock(features=features, norm_groups=norm_groups,
+                         dtype=dtype, name=name)
+
+
+class KLEncoder(nn.Module):
+    """Image -> (mean, logvar) of the latent Gaussian."""
+
+    latent_channels: int = 4
+    block_channels: Sequence[int] = (64, 128, 256)
+    layers_per_block: int = 2
+    norm_groups: int = 8
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        h = nn.Conv(self.block_channels[0], (3, 3), padding="SAME",
+                    dtype=self.dtype, name="conv_in")(x)
+        for i, ch in enumerate(self.block_channels):
+            for j in range(self.layers_per_block):
+                h = _res_block(ch, self.norm_groups, self.dtype,
+                               name=f"down_{i}_{j}")(h)
+            if i < len(self.block_channels) - 1:
+                h = Downsample(ch, dtype=self.dtype,
+                               name=f"downsample_{i}")(h)
+        h = _res_block(self.block_channels[-1], self.norm_groups,
+                       self.dtype, name="mid")(h)
+        h = nn.GroupNorm(num_groups=self.norm_groups, dtype=jnp.float32,
+                         name="norm_out")(h)
+        h = nn.Conv(2 * self.latent_channels, (3, 3), padding="SAME",
+                    dtype=jnp.float32, name="conv_out")(jax.nn.silu(h))
+        # 1x1 quant conv as in the SD VAE head (reference diffusers.py:53-60)
+        return nn.Conv(2 * self.latent_channels, (1, 1), dtype=jnp.float32,
+                       name="quant_conv")(h)
+
+
+class KLDecoder(nn.Module):
+    """Latent -> image."""
+
+    out_channels: int = 3
+    block_channels: Sequence[int] = (64, 128, 256)   # same order as encoder
+    layers_per_block: int = 2
+    norm_groups: int = 8
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, z: jax.Array) -> jax.Array:
+        chans = list(self.block_channels)[::-1]
+        h = nn.Conv(chans[0], (1, 1), dtype=self.dtype,
+                    name="post_quant_conv")(z)
+        h = nn.Conv(chans[0], (3, 3), padding="SAME", dtype=self.dtype,
+                    name="conv_in")(h)
+        h = _res_block(chans[0], self.norm_groups, self.dtype, name="mid")(h)
+        for i, ch in enumerate(chans):
+            for j in range(self.layers_per_block):
+                h = _res_block(ch, self.norm_groups, self.dtype,
+                               name=f"up_{i}_{j}")(h)
+            if i < len(chans) - 1:
+                h = Upsample(chans[i + 1], dtype=self.dtype,
+                             name=f"upsample_{i}")(h)
+        h = nn.GroupNorm(num_groups=self.norm_groups, dtype=jnp.float32,
+                         name="norm_out")(h)
+        return nn.Conv(self.out_channels, (3, 3), padding="SAME",
+                       dtype=jnp.float32, name="conv_out")(jax.nn.silu(h))
+
+
+def gaussian_sample(moments: jax.Array, key: Optional[jax.Array]
+                    ) -> jax.Array:
+    """Reparameterized sample (or mean if key is None) from concatenated
+    (mean, logvar) — reference diffusers.py:75-84."""
+    mean, logvar = jnp.split(moments, 2, axis=-1)
+    if key is None:
+        return mean
+    logvar = jnp.clip(logvar, -30.0, 20.0)
+    std = jnp.exp(0.5 * logvar)
+    return mean + std * jax.random.normal(key, mean.shape, dtype=mean.dtype)
+
+
+def kl_divergence(moments: jax.Array) -> jax.Array:
+    """KL(q || N(0,1)) per batch element, for VAE training."""
+    mean, logvar = jnp.split(moments, 2, axis=-1)
+    logvar = jnp.clip(logvar, -30.0, 20.0)
+    return 0.5 * jnp.sum(mean ** 2 + jnp.exp(logvar) - 1.0 - logvar,
+                         axis=tuple(range(1, mean.ndim)))
+
+
+class KLAutoEncoder(AutoEncoder):
+    """First-party trainable KL VAE bound to a parameter tree.
+
+    Construct with `KLAutoEncoder.create(key, ...)` for fresh params or pass
+    existing params. The jitted per-frame encode/decode mirror the
+    reference's SD wrapper surface (diffusers.py:72-96).
+    """
+
+    def __init__(self, params: PyTree, *, latent_channels: int = 4,
+                 out_channels: int = 3,
+                 block_channels: Sequence[int] = (64, 128, 256),
+                 layers_per_block: int = 2, norm_groups: int = 8,
+                 scaling_factor: float = 1.0,
+                 dtype: Optional[Dtype] = None):
+        self.params = params
+        self._latent_channels = latent_channels
+        self._out_channels = out_channels
+        self._block_channels = tuple(block_channels)
+        self._layers_per_block = layers_per_block
+        self._norm_groups = norm_groups
+        self.scaling_factor = scaling_factor
+        self.encoder = KLEncoder(latent_channels, self._block_channels,
+                                 layers_per_block, norm_groups, dtype)
+        self.decoder = KLDecoder(out_channels, self._block_channels,
+                                 layers_per_block, norm_groups, dtype)
+        self._downscale = 2 ** (len(self._block_channels) - 1)
+
+        # scaling_factor is a jit ARGUMENT, not a captured constant: users
+        # set it after measuring latent std (SD convention) and a baked-in
+        # trace would silently keep using the old value.
+        def _enc(params, x, key, scale):
+            moments = self.encoder.apply({"params": params["encoder"]}, x)
+            return gaussian_sample(moments, key) * scale
+
+        def _dec(params, z, scale):
+            return self.decoder.apply({"params": params["decoder"]},
+                                      z / scale)
+
+        self._enc = jax.jit(_enc)
+        self._enc_mean = jax.jit(lambda p, x, s: _enc(p, x, None, s))
+        self._dec = jax.jit(_dec)
+
+    @classmethod
+    def create(cls, key: jax.Array, *, input_channels: int = 3,
+               image_size: int = 64, **kwargs) -> "KLAutoEncoder":
+        ek, dk = jax.random.split(key)
+        latent_channels = kwargs.get("latent_channels", 4)
+        block_channels = tuple(kwargs.get("block_channels", (64, 128, 256)))
+        layers = kwargs.get("layers_per_block", 2)
+        groups = kwargs.get("norm_groups", 8)
+        dtype = kwargs.get("dtype", None)
+        enc = KLEncoder(latent_channels, block_channels, layers, groups, dtype)
+        dec = KLDecoder(kwargs.get("out_channels", input_channels),
+                        block_channels, layers, groups, dtype)
+        down = 2 ** (len(block_channels) - 1)
+        x = jnp.zeros((1, image_size, image_size, input_channels))
+        z = jnp.zeros((1, image_size // down, image_size // down,
+                       latent_channels))
+        params = {"encoder": enc.init(ek, x)["params"],
+                  "decoder": dec.init(dk, z)["params"]}
+        kwargs.setdefault("out_channels", input_channels)
+        return cls(params, **kwargs)
+
+    def moments(self, x: jax.Array) -> jax.Array:
+        """Raw (mean, logvar) — used by the VAE training loss."""
+        return self.encoder.apply({"params": self.params["encoder"]}, x)
+
+    def __encode__(self, x, key=None, **kwargs):
+        scale = jnp.float32(self.scaling_factor)
+        if key is None:
+            return self._enc_mean(self.params, x, scale)
+        return self._enc(self.params, x, key, scale)
+
+    def __decode__(self, z, key=None, **kwargs):
+        return self._dec(self.params, z, jnp.float32(self.scaling_factor))
+
+    @property
+    def downscale_factor(self) -> int:
+        return self._downscale
+
+    @property
+    def latent_channels(self) -> int:
+        return self._latent_channels
+
+    @property
+    def name(self) -> str:
+        return "kl_vae"
+
+    def serialize(self) -> Dict[str, Any]:
+        return {
+            "latent_channels": self._latent_channels,
+            "out_channels": self._out_channels,
+            "block_channels": list(self._block_channels),
+            "layers_per_block": self._layers_per_block,
+            "norm_groups": self._norm_groups,
+            "scaling_factor": self.scaling_factor,
+        }
+
+
+class StableDiffusionVAE(AutoEncoder):
+    """Wrapper over the pretrained SD VAE via the optional `diffusers`
+    package (reference diffusers.py:14-153). Raises a clear ImportError when
+    diffusers is not installed."""
+
+    def __init__(self, modelname: str = "CompVis/stable-diffusion-v1-4",
+                 revision: str = "bf16", dtype: Dtype = jnp.bfloat16):
+        try:
+            from diffusers import FlaxAutoencoderKL
+            from diffusers.models.vae_flax import FlaxDecoder, FlaxEncoder
+        except ImportError as e:
+            raise ImportError(
+                "StableDiffusionVAE requires the optional `diffusers` "
+                "package; install it or use KLAutoEncoder (first-party)."
+            ) from e
+        vae, params = FlaxAutoencoderKL.from_pretrained(
+            modelname, revision=revision, dtype=dtype)
+        self.modelname, self.revision, self.dtype = modelname, revision, dtype
+        self._vae, self._params = vae, params
+        self.scaling_factor = vae.config.scaling_factor
+
+        # Call the NHWC FlaxEncoder/FlaxDecoder submodules directly: the
+        # top-level FlaxAutoencoderKL.encode/decode take NCHW at the public
+        # boundary, which would layout-mangle this NHWC pipeline (reference
+        # diffusers.py:30-96 uses the same submodule approach).
+        enc_mod = FlaxEncoder(
+            in_channels=vae.config.in_channels,
+            out_channels=vae.config.latent_channels,
+            down_block_types=vae.config.down_block_types,
+            block_out_channels=vae.config.block_out_channels,
+            layers_per_block=vae.config.layers_per_block,
+            act_fn=vae.config.act_fn,
+            norm_num_groups=vae.config.norm_num_groups,
+            double_z=True, dtype=dtype)
+        dec_mod = FlaxDecoder(
+            in_channels=vae.config.latent_channels,
+            out_channels=vae.config.out_channels,
+            up_block_types=vae.config.up_block_types,
+            block_out_channels=vae.config.block_out_channels,
+            layers_per_block=vae.config.layers_per_block,
+            act_fn=vae.config.act_fn,
+            norm_num_groups=vae.config.norm_num_groups,
+            dtype=dtype)
+        quant = nn.Conv(2 * vae.config.latent_channels, (1, 1),
+                        padding="VALID", dtype=dtype)
+        post_quant = nn.Conv(vae.config.latent_channels, (1, 1),
+                             padding="VALID", dtype=dtype)
+
+        def _enc(x, key):
+            h = enc_mod.apply({"params": params["encoder"]}, x,
+                              deterministic=True)
+            moments = quant.apply({"params": params["quant_conv"]}, h)
+            return gaussian_sample(moments, key) * self.scaling_factor
+
+        def _dec(z):
+            z = post_quant.apply({"params": params["post_quant_conv"]},
+                                 z / self.scaling_factor)
+            return dec_mod.apply({"params": params["decoder"]}, z,
+                                 deterministic=True)
+
+        self._enc = jax.jit(_enc, static_argnums=())
+        self._dec = jax.jit(_dec)
+        probe = self._enc(jnp.ones((1, 64, 64, 3), dtype), None)
+        self._downscale = 64 // probe.shape[1]
+        self._latent_channels = probe.shape[-1]
+
+    def __encode__(self, x, key=None, **kwargs):
+        return self._enc(x, key)
+
+    def __decode__(self, z, key=None, **kwargs):
+        return self._dec(z)
+
+    @property
+    def downscale_factor(self) -> int:
+        return self._downscale
+
+    @property
+    def latent_channels(self) -> int:
+        return self._latent_channels
+
+    @property
+    def name(self) -> str:
+        return "stable_diffusion"
+
+    def serialize(self) -> Dict[str, Any]:
+        return {"modelname": self.modelname, "revision": self.revision,
+                "dtype": str(self.dtype)}
+
+
+AUTOENCODER_REGISTRY = {
+    "identity": IdentityAutoEncoder,
+    "kl_vae": KLAutoEncoder,
+    "stable_diffusion": StableDiffusionVAE,
+}

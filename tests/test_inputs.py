@@ -1,0 +1,104 @@
+"""Tests for conditioning inputs: encoders, configs, CFG dropout splice."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flaxdiff_tpu.inputs import (
+    ConditionalInputConfig,
+    DiffusionInputConfig,
+    HashTextEncoder,
+)
+from flaxdiff_tpu.models.autoencoder import KLAutoEncoder
+import jax
+
+
+@pytest.fixture(scope="module")
+def encoder():
+    return HashTextEncoder.create(vocab_size=512, features=16, max_length=8)
+
+
+def test_hash_encoder_deterministic(encoder):
+    a = np.asarray(encoder(["a red flower", "blue sky"]))
+    b = np.asarray(encoder(["a red flower", "blue sky"]))
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (2, 8, 16)
+    # distinct texts -> distinct embeddings
+    assert not np.allclose(a[0], a[1])
+
+
+def test_hash_encoder_empty_string(encoder):
+    out = np.asarray(encoder([""]))
+    assert np.all(np.isfinite(out))
+    # empty differs from a real prompt
+    assert not np.allclose(out, np.asarray(encoder(["flower"])))
+
+
+def test_conditional_input_cached_uncond(encoder):
+    cfg = ConditionalInputConfig(encoder=encoder)
+    uncond = cfg.get_unconditional()
+    np.testing.assert_array_equal(np.asarray(uncond),
+                                  np.asarray(encoder([""])))
+    assert cfg.batch_key == "text"
+
+
+def test_conditional_input_pretokenized(encoder):
+    cfg = ConditionalInputConfig(encoder=encoder, pretokenized=True)
+    tokens = encoder.tokenize(["hello world"])
+    out = cfg({"text": tokens})
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(encoder(["hello world"])))
+
+
+def test_process_conditioning_cfg_splice(encoder):
+    cfg = DiffusionInputConfig(
+        sample_data_key="image", sample_data_shape=(16, 16, 3),
+        conditions=[ConditionalInputConfig(encoder=encoder)])
+    batch = {"text": ["a", "b", "c", "d"]}
+    mask = jnp.asarray([True, False, True, False])
+    [emb] = cfg.process_conditioning(batch, uncond_mask=mask)
+    full = np.asarray(encoder(["a", "b", "c", "d"]))
+    uncond = np.asarray(encoder([""]))[0]
+    np.testing.assert_allclose(np.asarray(emb[0]), uncond, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(emb[1]), full[1], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(emb[2]), uncond, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(emb[3]), full[3], rtol=1e-6)
+
+
+def test_get_input_shapes_vae_aware(encoder):
+    cfg = DiffusionInputConfig(
+        sample_data_key="image", sample_data_shape=(16, 16, 3),
+        conditions=[ConditionalInputConfig(encoder=encoder)])
+    shapes = cfg.get_input_shapes()
+    assert shapes["x"] == (16, 16, 3)
+    assert shapes["temb"] == ()
+    assert shapes["text"] == (8, 16)
+
+    vae = KLAutoEncoder.create(jax.random.PRNGKey(0), input_channels=3,
+                               image_size=16, latent_channels=2,
+                               block_channels=(8, 16), layers_per_block=1,
+                               norm_groups=4)
+    shapes = cfg.get_input_shapes(autoencoder=vae)
+    assert shapes["x"] == (8, 8, 2)
+
+
+def test_video_input_shapes(encoder):
+    cfg = DiffusionInputConfig(
+        sample_data_key="video", sample_data_shape=(5, 16, 16, 3),
+        conditions=[])
+    assert cfg.get_input_shapes()["x"] == (5, 16, 16, 3)
+
+
+def test_serialize_roundtrip(encoder):
+    cfg = DiffusionInputConfig(
+        sample_data_key="image", sample_data_shape=(8, 8, 3),
+        conditions=[ConditionalInputConfig(
+            encoder=encoder, unconditional_input=None)])
+    blob = cfg.serialize()
+    # Hash encoders deserialize without network access.
+    blob["conditions"][0]["encoder_key"] = "hash"
+    restored = DiffusionInputConfig.deserialize(blob)
+    assert restored.sample_data_key == "image"
+    assert restored.sample_data_shape == (8, 8, 3)
+    np.testing.assert_array_equal(
+        np.asarray(restored.conditions[0].get_unconditional()),
+        np.asarray(cfg.conditions[0].get_unconditional()))
