@@ -9,6 +9,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 
+from ..utils import cfg_uncond_splice
 from .encoders import CONDITIONAL_ENCODERS_REGISTRY, ConditioningEncoder
 
 
@@ -47,10 +48,10 @@ class ConditionalInputConfig:
         return self._uncond_cache
 
     def serialize(self) -> Dict[str, Any]:
+        enc_cfg = self.encoder.serialize()
         return {
-            "encoder": self.encoder.serialize(),
-            "encoder_key": self.encoder.serialize().get("type",
-                                                        self.encoder.key),
+            "encoder": enc_cfg,
+            "encoder_key": enc_cfg.get("type", self.encoder.key),
             "conditioning_data_key": self.conditioning_data_key,
             "pretokenized": self.pretokenized,
             "unconditional_input": self.unconditional_input,
@@ -96,15 +97,26 @@ class DiffusionInputConfig:
                 f"unsupported sample shape {self.sample_data_shape}")
         if autoencoder is not None:
             d = autoencoder.downscale_factor
-            H, W, C = H // d, W // d, autoencoder.latent_channels
+            # ceil-divide: SAME-padded stride-2 convs produce ceil(H/2)
+            # per stage, so non-divisible sizes round UP, not down.
+            H, W, C = -(-H // d), -(-W // d), autoencoder.latent_channels
         shapes = {sample_model_key: (*lead, H, W, C),
                   time_embeddings_model_key: ()}
         for cond in self.conditions:
             shapes[cond.model_key] = tuple(cond.get_unconditional()[0].shape)
         return shapes
 
-    def get_unconditionals(self):
-        return [c.get_unconditional() for c in self.conditions]
+    def get_unconditionals(self, batch_size: Optional[int] = None):
+        """Cached null embeddings, optionally tiled to `batch_size` so they
+        can feed the sampler's CFG concat path directly (the sampler stacks
+        [cond; uncond] along batch — samplers/common.py)."""
+        out = []
+        for c in self.conditions:
+            u = jnp.asarray(c.get_unconditional())
+            if batch_size is not None:
+                u = jnp.broadcast_to(u, (batch_size,) + u.shape[1:])
+            out.append(u)
+        return out
 
     def process_conditioning(self, batch_data,
                              uncond_mask: Optional[jnp.ndarray] = None):
@@ -114,16 +126,8 @@ class DiffusionInputConfig:
         for cond in self.conditions:
             emb = cond(batch_data)
             if uncond_mask is not None:
-                if uncond_mask.shape[0] != emb.shape[0]:
-                    raise ValueError(
-                        f"uncond_mask batch {uncond_mask.shape[0]} != "
-                        f"embedding batch {emb.shape[0]}")
-                uncond = jnp.asarray(cond.get_unconditional())
-                mask = uncond_mask.reshape(
-                    (emb.shape[0],) + (1,) * (emb.ndim - 1))
-                uncond_b = jnp.broadcast_to(
-                    uncond.astype(emb.dtype), emb.shape)
-                emb = jnp.where(mask, uncond_b, emb)
+                emb = cfg_uncond_splice(
+                    emb, jnp.asarray(cond.get_unconditional()), uncond_mask)
             results.append(emb)
         return results
 

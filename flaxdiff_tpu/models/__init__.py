@@ -26,6 +26,7 @@ from .ssm import (
     build_block_pattern,
 )
 from .unet import Unet
+from .unet3d import TemporalAttention, TemporalConvLayer, UNet3D, UNet3DBlock
 from .uvit import SimpleUDiT, UViT
 from .vit_common import (
     AdaLNParams,

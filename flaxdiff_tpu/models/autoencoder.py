@@ -368,9 +368,9 @@ class StableDiffusionVAE(AutoEncoder):
 
         self._enc = jax.jit(_enc, static_argnums=())
         self._dec = jax.jit(_dec)
-        probe = self._enc(jnp.ones((1, 64, 64, 3), dtype), None)
-        self._downscale = 64 // probe.shape[1]
-        self._latent_channels = probe.shape[-1]
+        # Both are statically known from the config — no probe forward needed.
+        self._downscale = 2 ** (len(vae.config.block_out_channels) - 1)
+        self._latent_channels = vae.config.latent_channels
 
     def __encode__(self, x, key=None, **kwargs):
         return self._enc(x, key)

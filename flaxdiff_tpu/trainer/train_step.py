@@ -28,7 +28,7 @@ import optax
 from ..predictors import PredictionTransform
 from ..schedulers.common import NoiseSchedule, bcast_right
 from ..typing import Policy, PyTree
-from ..utils import normalize_images
+from ..utils import cfg_uncond_splice, normalize_images
 from .train_state import TrainState
 
 
@@ -76,15 +76,11 @@ def make_train_step(
 
         cond = batch.get("cond", None)
         if cond is not None and null_cond is not None and config.uncond_prob > 0:
-            B = x0.shape[0]
             uncond_mask = jax.random.bernoulli(
-                uncond_key, config.uncond_prob, (B,))
-
-            def splice(c, u):
-                mask = uncond_mask.reshape((B,) + (1,) * (c.ndim - 1))
-                return jnp.where(mask, u.astype(c.dtype), c)
-
-            cond = jax.tree_util.tree_map(splice, cond, null_cond)
+                uncond_key, config.uncond_prob, (x0.shape[0],))
+            cond = jax.tree_util.tree_map(
+                lambda c, u: cfg_uncond_splice(c, u, uncond_mask),
+                cond, null_cond)
 
         B = x0.shape[0]
         t = schedule.sample_timesteps(t_key, B)

@@ -65,6 +65,23 @@ def clip_images(x: jax.Array, clip_min: float = -1.0, clip_max: float = 1.0) -> 
     return jnp.clip(x, clip_min, clip_max)
 
 
+def cfg_uncond_splice(emb: jax.Array, uncond: jax.Array,
+                      uncond_mask: jax.Array) -> jax.Array:
+    """CFG-dropout splice: where uncond_mask[b] is True, replace sample b's
+    conditioning with the (broadcast) null embedding via jnp.where — the
+    reference's correct masking semantics (inputs/__init__.py:122-137).
+
+    Single source of truth for both the train step and input-config paths.
+    """
+    if uncond_mask.shape[0] != emb.shape[0]:
+        raise ValueError(
+            f"uncond_mask batch {uncond_mask.shape[0]} != "
+            f"embedding batch {emb.shape[0]}")
+    mask = uncond_mask.reshape((emb.shape[0],) + (1,) * (emb.ndim - 1))
+    uncond_b = jnp.broadcast_to(uncond.astype(emb.dtype), emb.shape)
+    return jnp.where(mask, uncond_b, emb)
+
+
 def count_params(tree: PyTree) -> int:
     return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(tree)
                if hasattr(x, "shape"))
