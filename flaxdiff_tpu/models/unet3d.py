@@ -86,13 +86,12 @@ class TemporalAttention(nn.Module):
                           name="norm")(x5)
         # [B, F, H, W, C] -> [B*H*W, F, C]
         tokens = h5.transpose(0, 2, 3, 1, 4).reshape(b * h * w, num_frames, c)
+        # zero-init the attention's own output projection so the block
+        # starts as identity — no second projection matmul needed.
         tokens = RoPEAttention(
             heads=self.heads, dim_head=max(c // self.heads, 1),
             backend=self.backend, dtype=self.dtype, precision=self.precision,
-            name="attn")(tokens)
-        # zero-init out proj so the block starts as identity
-        tokens = nn.Dense(c, kernel_init=nn.initializers.zeros,
-                          dtype=jnp.float32, name="proj_out")(tokens)
+            out_kernel_init=nn.initializers.zeros, name="attn")(tokens)
         h5 = tokens.reshape(b, h, w, num_frames, c).transpose(0, 3, 1, 2, 4)
         return (residual + h5).reshape(bf, h, w, c)
 
@@ -109,12 +108,14 @@ class UNet3DBlock(nn.Module):
     backend: str = "auto"
     dtype: Optional[Dtype] = None
     precision: Optional[jax.lax.Precision] = None
+    activation: Callable = jax.nn.swish
 
     @nn.compact
     def __call__(self, x: jax.Array, temb: jax.Array, context,
                  num_frames: int) -> jax.Array:
         x = ResidualBlock(features=self.features,
-                          norm_groups=self.norm_groups, dtype=self.dtype,
+                          norm_groups=self.norm_groups,
+                          activation=self.activation, dtype=self.dtype,
                           precision=self.precision, name="res")(x, temb)
         x = TemporalConvLayer(features=self.features,
                               norm_groups=self.norm_groups, dtype=self.dtype,
@@ -185,6 +186,7 @@ class UNet3D(nn.Module):
                     use_attention=self.attention_levels[i],
                     norm_groups=self.norm_groups, backend=self.backend,
                     dtype=self.dtype, precision=self.precision,
+                    activation=self.activation,
                     name=f"down_{i}_{j}")(h, tf, ctx, F)
                 skips.append(h)
             if i < len(self.feature_depths) - 1:
@@ -204,7 +206,8 @@ class UNet3D(nn.Module):
         h = UNet3DBlock(features=self.feature_depths[-1], heads=self.heads,
                         use_attention=True, norm_groups=self.norm_groups,
                         backend=self.backend, dtype=self.dtype,
-                        precision=self.precision, name="mid")(h, tf, ctx, F)
+                        precision=self.precision,
+                        activation=self.activation, name="mid")(h, tf, ctx, F)
         if mid_block_additional_residual is not None:
             h = h + mid_block_additional_residual
 
@@ -217,6 +220,7 @@ class UNet3D(nn.Module):
                     use_attention=self.attention_levels[level],
                     norm_groups=self.norm_groups, backend=self.backend,
                     dtype=self.dtype, precision=self.precision,
+                    activation=self.activation,
                     name=f"up_{i}_{j}")(h, tf, ctx, F)
             if level > 0:
                 h = Upsample(feats, dtype=self.dtype,
@@ -227,5 +231,5 @@ class UNet3D(nn.Module):
                          name="norm_out")(h)
         h = nn.Conv(self.output_channels, (3, 3), padding="SAME",
                     dtype=jnp.float32, kernel_init=nn.initializers.zeros,
-                    name="conv_out")(jax.nn.silu(h))
+                    name="conv_out")(self.activation(h))
         return h.reshape(B, F, H, W, self.output_channels)

@@ -114,6 +114,7 @@ class RoPEAttention(nn.Module):
     precision: Optional[jax.lax.Precision] = None
     use_bias: bool = True
     force_fp32_for_softmax: bool = True
+    out_kernel_init: Optional[nn.initializers.Initializer] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, context: Optional[jax.Array] = None,
@@ -142,9 +143,12 @@ class RoPEAttention(nn.Module):
         out = dot_product_attention(
             q, k, v, backend=self.backend,
             force_fp32_for_softmax=self.force_fp32_for_softmax)
+        out_init = (self.out_kernel_init if self.out_kernel_init is not None
+                    else nn.linear.default_kernel_init)
         out = nn.DenseGeneral(
             x.shape[-1], axis=(-2, -1), use_bias=self.use_bias,
-            dtype=self.dtype, precision=self.precision, name="to_out")(out)
+            dtype=self.dtype, precision=self.precision,
+            kernel_init=out_init, name="to_out")(out)
         if spatial:
             out = out.reshape(b, h, w, c)
         return out

@@ -9,6 +9,11 @@ all through `jax.sharding.NamedSharding` so XLA SPMD emits the
 reduce-scatter/all-gather pattern over ICI.
 """
 from .mesh import MeshAxes, create_mesh, local_batch_size, mesh_shape_for
+from .ring_attention import (
+    ring_attention_sharded,
+    ring_self_attention,
+    sequence_sharding,
+)
 from .partition import (
     PartitionRule,
     fsdp_sharding_tree,
@@ -22,6 +27,9 @@ from .partition import (
 __all__ = [
     "MeshAxes",
     "create_mesh",
+    "ring_attention_sharded",
+    "ring_self_attention",
+    "sequence_sharding",
     "local_batch_size",
     "mesh_shape_for",
     "PartitionRule",

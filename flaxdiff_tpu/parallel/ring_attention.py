@@ -1,0 +1,106 @@
+"""Ring attention: exact sequence-parallel attention over a mesh axis.
+
+The reference has NO sequence parallelism of any kind (SURVEY.md §5.7);
+this is the TPU-native extension that lifts the single-device sequence
+bound. Algorithm (Liu et al. 2023, Ring Attention with Blockwise
+Transformers): each device holds one sequence shard of Q and of K/V; K/V
+shards rotate around the ring via `jax.lax.ppermute` while every device
+accumulates its Q-shard's attention with the numerically-stable online
+softmax (running max / running sum), so the full [S, S] score matrix is
+never materialized and communication overlaps compute on the ICI ring.
+
+Exactness: the result equals full softmax attention over the complete
+sequence (verified against the XLA path in tests/test_ring_attention.py).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+
+def _online_block(carry, kv_block, q, scale):
+    """Accumulate one K/V block into the (out, running_sum, running_max)
+    online-softmax carry. Shapes: q [B, Sq, H, D]; k/v [B, Skv, H, D];
+    carry o [B, Sq, H, D], l [B, H, Sq], m [B, H, Sq]."""
+    o, l, m = carry
+    k, v = kv_block
+    # scores in f32 for a stable softmax regardless of compute dtype
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    m_blk = jnp.max(s, axis=-1)                        # [B, H, Sq]
+    m_new = jnp.maximum(m, m_blk)
+    p = jnp.exp(s - m_new[..., None])                  # [B, H, Sq, Skv]
+    corr = jnp.exp(m - m_new)                          # [B, H, Sq]
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+    return o_new, l_new, m_new
+
+
+def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                           axis_name: str, scale: Optional[float] = None
+                           ) -> jax.Array:
+    """Body to be called INSIDE shard_map: q/k/v are the local sequence
+    shards [B, S_local, H, D]; the sequence axis is sharded over
+    `axis_name`. Returns the local shard of the attention output."""
+    B, Sq, H, D = q.shape
+    scale = scale if scale is not None else D ** -0.5
+    n = jax.lax.psum(1, axis_name)
+
+    # Derive the zero-init carry from q so it inherits q's full set of
+    # device-varying axes (shard_map's varying-axis checker requires the
+    # fori_loop carry type to match the accumulator outputs exactly).
+    o = (q * 0).astype(jnp.float32)                       # [B, Sq, H, D]
+    l = jnp.sum(o, axis=-1).transpose(0, 2, 1)            # [B, H, Sq]
+    m = l - jnp.inf
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(i, state):
+        o, l, m, k_cur, v_cur = state
+        o, l, m = _online_block((o, l, m), (k_cur, v_cur), q, scale)
+        # rotate K/V one hop around the ring; the last rotation is wasted
+        # but keeps the loop body uniform (static unrolled by scan).
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return o, l, m, k_nxt, v_nxt
+
+    o, l, m, _, _ = jax.lax.fori_loop(0, n, step, (o, l, m, k, v))
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        mesh: Mesh, seq_axis: str = "seq",
+                        batch_axes: Tuple[str, ...] = ("data",),
+                        scale: Optional[float] = None) -> jax.Array:
+    """Top-level entry: [B, S, H, D] arrays, S sharded over `seq_axis`,
+    B over `batch_axes`. Wraps `ring_attention_sharded` in shard_map so
+    XLA SPMD emits the ppermute ring over ICI."""
+    b_spec = tuple(a for a in batch_axes if a in mesh.axis_names)
+    b = b_spec if len(b_spec) != 1 else b_spec[0]
+    spec = P(b if b_spec else None, seq_axis, None, None)
+    fn = shard_map(
+        functools.partial(ring_attention_sharded, axis_name=seq_axis,
+                          scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def sequence_sharding(mesh: Mesh, seq_axis: str = "seq",
+                      batch_axes: Tuple[str, ...] = ("data",)
+                      ) -> NamedSharding:
+    """NamedSharding for [B, S, ...] activations with S on the seq axis."""
+    b_spec = tuple(a for a in batch_axes if a in mesh.axis_names)
+    b = b_spec if len(b_spec) != 1 else b_spec[0]
+    return NamedSharding(mesh, P(b if b_spec else None, seq_axis))
