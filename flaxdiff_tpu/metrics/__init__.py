@@ -1,0 +1,24 @@
+"""Evaluation metrics (capability parity: reference flaxdiff/metrics/)."""
+from .clip_metrics import (
+    clip_score,
+    cosine_similarity,
+    get_clip_metric,
+    get_clip_score_metric,
+)
+from .common import EvaluationMetric, MetricTracker
+from .fid import FeatureStats, FIDComputer, frechet_distance
+from .inception import InceptionV3Features, make_inception_extractor
+
+__all__ = [
+    "EvaluationMetric",
+    "MetricTracker",
+    "FeatureStats",
+    "FIDComputer",
+    "frechet_distance",
+    "InceptionV3Features",
+    "make_inception_extractor",
+    "cosine_similarity",
+    "clip_score",
+    "get_clip_metric",
+    "get_clip_score_metric",
+]

@@ -1,0 +1,83 @@
+"""CLIP-based image/text metrics (reference flaxdiff/metrics/images.py:14-111).
+
+The CLIP model is cached at module level (the reference does the same);
+loading requires downloadable weights, so construction is gated and the
+similarity math is exposed as pure, weight-free functions.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import denormalize_images
+from .common import EvaluationMetric
+
+_CLIP_CACHE: dict = {}
+
+
+def cosine_similarity(a: jax.Array, b: jax.Array, eps: float = 1e-8
+                      ) -> jax.Array:
+    """Row-wise cosine similarity between [N, D] feature batches."""
+    a = a / (jnp.linalg.norm(a, axis=-1, keepdims=True) + eps)
+    b = b / (jnp.linalg.norm(b, axis=-1, keepdims=True) + eps)
+    return jnp.sum(a * b, axis=-1)
+
+
+def clip_score(image_feats: jax.Array, text_feats: jax.Array,
+               w: float = 2.5) -> jax.Array:
+    """CLIPScore (Hessel et al. 2021): w * max(cos, 0), averaged by caller."""
+    return w * jnp.maximum(cosine_similarity(image_feats, text_feats), 0.0)
+
+
+def _load_clip(modelname: str):
+    if modelname in _CLIP_CACHE:
+        return _CLIP_CACHE[modelname]
+    try:
+        from transformers import AutoProcessor, FlaxCLIPModel
+        model = FlaxCLIPModel.from_pretrained(modelname, dtype=jnp.float16)
+        processor = AutoProcessor.from_pretrained(modelname)
+    except Exception as e:
+        raise RuntimeError(
+            f"Could not load CLIP weights for {modelname!r} (offline?). "
+            "CLIP metrics need downloadable weights.") from e
+    _CLIP_CACHE[modelname] = (model, processor)
+    return model, processor
+
+
+def _clip_features(images: np.ndarray, prompts, modelname: str):
+    model, processor = _load_clip(modelname)
+    inputs = processor(text=list(prompts), images=list(np.asarray(images)),
+                       return_tensors="np", padding=True)
+    img_feats = model.get_image_features(pixel_values=inputs["pixel_values"])
+    txt_feats = model.get_text_features(input_ids=inputs["input_ids"],
+                                        attention_mask=inputs["attention_mask"])
+    return img_feats, txt_feats
+
+
+def get_clip_metric(modelname: str = "openai/clip-vit-large-patch14",
+                    prompt_key: str = "text") -> EvaluationMetric:
+    """1 - cos(image, text): lower is better (reference images.py:54-83)."""
+
+    def fn(samples, batch):
+        imgs = np.asarray(denormalize_images(samples))
+        img_f, txt_f = _clip_features(imgs, batch[prompt_key], modelname)
+        return float(1.0 - jnp.mean(cosine_similarity(img_f, txt_f)))
+
+    return EvaluationMetric(function=fn, name="clip_distance",
+                            higher_is_better=False)
+
+
+def get_clip_score_metric(modelname: str = "openai/clip-vit-large-patch14",
+                          prompt_key: str = "text") -> EvaluationMetric:
+    """Mean CLIPScore: higher is better (reference images.py:86-111)."""
+
+    def fn(samples, batch):
+        imgs = np.asarray(denormalize_images(samples))
+        img_f, txt_f = _clip_features(imgs, batch[prompt_key], modelname)
+        return float(jnp.mean(clip_score(img_f, txt_f)))
+
+    return EvaluationMetric(function=fn, name="clip_score",
+                            higher_is_better=True)

@@ -1,0 +1,107 @@
+"""FID: Frechet distance over feature statistics, with online accumulation.
+
+The reference ports InceptionV3 (metrics/inception.py) but never wires FID
+into any trainer (SURVEY.md §5.5 "FID infra exists but unused"); here the
+computation layer is finished and extractor-agnostic: any
+`features(images) -> [N, D]` callable plugs in (InceptionV3 for standard
+FID-10k, or CLIP features for CLIP-FID).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.linalg
+
+
+@dataclasses.dataclass
+class FeatureStats:
+    """Streaming mean/covariance accumulator (Welford-style, batch form)."""
+
+    n: int = 0
+    sum: Optional[np.ndarray] = None          # [D]
+    outer: Optional[np.ndarray] = None        # [D, D] sum of x x^T
+
+    def update(self, feats: np.ndarray):
+        feats = np.asarray(feats, np.float64)
+        if feats.ndim != 2:
+            raise ValueError(f"features must be [N, D], got {feats.shape}")
+        if self.sum is None:
+            d = feats.shape[1]
+            self.sum = np.zeros(d)
+            self.outer = np.zeros((d, d))
+        self.n += feats.shape[0]
+        self.sum += feats.sum(axis=0)
+        self.outer += feats.T @ feats
+
+    @property
+    def mean(self) -> np.ndarray:
+        return self.sum / self.n
+
+    @property
+    def cov(self) -> np.ndarray:
+        mu = self.mean
+        # unbiased covariance from accumulated outer products
+        return (self.outer - self.n * np.outer(mu, mu)) / max(self.n - 1, 1)
+
+
+def frechet_distance(mu1, cov1, mu2, cov2, eps: float = 1e-6) -> float:
+    """FID = |mu1-mu2|^2 + Tr(C1 + C2 - 2 sqrt(C1 C2)) (Heusel et al. 2017)."""
+    mu1, mu2 = np.asarray(mu1, np.float64), np.asarray(mu2, np.float64)
+    cov1, cov2 = np.asarray(cov1, np.float64), np.asarray(cov2, np.float64)
+    diff = mu1 - mu2
+
+    def _sqrtm(a):
+        out = scipy.linalg.sqrtm(a)
+        # older scipy returns (sqrtm, errest) with disp=False; plain call
+        # returns just the matrix across versions
+        return out[0] if isinstance(out, tuple) else out
+
+    covmean = _sqrtm(cov1 @ cov2)
+    if not np.isfinite(covmean).all():
+        # regularize near-singular products
+        offset = np.eye(cov1.shape[0]) * eps
+        covmean = _sqrtm((cov1 + offset) @ (cov2 + offset))
+    if np.iscomplexobj(covmean):
+        covmean = covmean.real
+    return float(diff @ diff + np.trace(cov1) + np.trace(cov2)
+                 - 2.0 * np.trace(covmean))
+
+
+class FIDComputer:
+    """Accumulate reference and generated feature stats; compute FID.
+
+    `extractor(images_uint8_or_float[N,H,W,C]) -> [N, D]` features.
+    """
+
+    def __init__(self, extractor: Callable[[np.ndarray], np.ndarray],
+                 batch_size: int = 64):
+        self.extractor = extractor
+        self.batch_size = batch_size
+        self.real = FeatureStats()
+        self.fake = FeatureStats()
+
+    def _accumulate(self, stats: FeatureStats, images: np.ndarray):
+        for i in range(0, len(images), self.batch_size):
+            feats = self.extractor(images[i:i + self.batch_size])
+            stats.update(np.asarray(jax.device_get(feats)))
+
+    def add_real(self, images: np.ndarray):
+        self._accumulate(self.real, images)
+
+    def add_generated(self, images: np.ndarray):
+        self._accumulate(self.fake, images)
+
+    def compute(self) -> float:
+        if self.real.n < 2 or self.fake.n < 2:
+            raise ValueError(
+                f"need >=2 samples per side, have real={self.real.n} "
+                f"fake={self.fake.n}")
+        return frechet_distance(self.real.mean, self.real.cov,
+                                self.fake.mean, self.fake.cov)
+
+    def reset_generated(self):
+        self.fake = FeatureStats()

@@ -1,0 +1,201 @@
+"""First-party Flax InceptionV3 (FID pool3 feature variant).
+
+Capability parity with reference flaxdiff/metrics/inception.py:22-657 (a
+Flax port of pytorch-FID's InceptionV3). Standard Szegedy et al. 2015
+architecture producing the 2048-D pool3 features used by FID. Pretrained
+FID weights must be supplied locally (`params_file`, .npz/.msgpack) — this
+environment has no network egress; with random init the module is still
+shape/flow-testable and usable as a fixed random-projection extractor.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class BasicConv(nn.Module):
+    """conv -> BatchNorm(eps=1e-3, inference stats) -> relu."""
+
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: str | Sequence[Tuple[int, int]] = "VALID"
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=False, name="conv")(x)
+        x = nn.BatchNorm(use_running_average=True, epsilon=1e-3,
+                         name="bn")(x)
+        return jax.nn.relu(x)
+
+
+def _pool(x, window, strides, padding="VALID", kind="max"):
+    if kind == "max":
+        return nn.max_pool(x, (window, window), (strides, strides), padding)
+    return nn.avg_pool(x, (window, window), (strides, strides), padding,
+                       count_include_pad=False)
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = BasicConv(64, (1, 1), name="branch1x1")(x)
+        b5 = BasicConv(48, (1, 1), name="branch5x5_1")(x)
+        b5 = BasicConv(64, (5, 5), padding=[(2, 2), (2, 2)],
+                       name="branch5x5_2")(b5)
+        b3 = BasicConv(64, (1, 1), name="branch3x3dbl_1")(x)
+        b3 = BasicConv(96, (3, 3), padding=[(1, 1), (1, 1)],
+                       name="branch3x3dbl_2")(b3)
+        b3 = BasicConv(96, (3, 3), padding=[(1, 1), (1, 1)],
+                       name="branch3x3dbl_3")(b3)
+        bp = _pool(x, 3, 1, "SAME", "avg")
+        bp = BasicConv(self.pool_features, (1, 1), name="branch_pool")(bp)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        b3 = BasicConv(384, (3, 3), strides=(2, 2), name="branch3x3")(x)
+        bd = BasicConv(64, (1, 1), name="branch3x3dbl_1")(x)
+        bd = BasicConv(96, (3, 3), padding=[(1, 1), (1, 1)],
+                       name="branch3x3dbl_2")(bd)
+        bd = BasicConv(96, (3, 3), strides=(2, 2), name="branch3x3dbl_3")(bd)
+        bp = _pool(x, 3, 2)
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    channels_7x7: int
+
+    @nn.compact
+    def __call__(self, x):
+        c7 = self.channels_7x7
+        b1 = BasicConv(192, (1, 1), name="branch1x1")(x)
+        b7 = BasicConv(c7, (1, 1), name="branch7x7_1")(x)
+        b7 = BasicConv(c7, (1, 7), padding=[(0, 0), (3, 3)],
+                       name="branch7x7_2")(b7)
+        b7 = BasicConv(192, (7, 1), padding=[(3, 3), (0, 0)],
+                       name="branch7x7_3")(b7)
+        bd = BasicConv(c7, (1, 1), name="branch7x7dbl_1")(x)
+        bd = BasicConv(c7, (7, 1), padding=[(3, 3), (0, 0)],
+                       name="branch7x7dbl_2")(bd)
+        bd = BasicConv(c7, (1, 7), padding=[(0, 0), (3, 3)],
+                       name="branch7x7dbl_3")(bd)
+        bd = BasicConv(c7, (7, 1), padding=[(3, 3), (0, 0)],
+                       name="branch7x7dbl_4")(bd)
+        bd = BasicConv(192, (1, 7), padding=[(0, 0), (3, 3)],
+                       name="branch7x7dbl_5")(bd)
+        bp = _pool(x, 3, 1, "SAME", "avg")
+        bp = BasicConv(192, (1, 1), name="branch_pool")(bp)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        b3 = BasicConv(192, (1, 1), name="branch3x3_1")(x)
+        b3 = BasicConv(320, (3, 3), strides=(2, 2), name="branch3x3_2")(b3)
+        b7 = BasicConv(192, (1, 1), name="branch7x7x3_1")(x)
+        b7 = BasicConv(192, (1, 7), padding=[(0, 0), (3, 3)],
+                       name="branch7x7x3_2")(b7)
+        b7 = BasicConv(192, (7, 1), padding=[(3, 3), (0, 0)],
+                       name="branch7x7x3_3")(b7)
+        b7 = BasicConv(192, (3, 3), strides=(2, 2), name="branch7x7x3_4")(b7)
+        bp = _pool(x, 3, 2)
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    pool_kind: str = "avg"   # FID variant uses max-pool in the last block
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = BasicConv(320, (1, 1), name="branch1x1")(x)
+        b3 = BasicConv(384, (1, 1), name="branch3x3_1")(x)
+        b3a = BasicConv(384, (1, 3), padding=[(0, 0), (1, 1)],
+                        name="branch3x3_2a")(b3)
+        b3b = BasicConv(384, (3, 1), padding=[(1, 1), (0, 0)],
+                        name="branch3x3_2b")(b3)
+        b3 = jnp.concatenate([b3a, b3b], axis=-1)
+        bd = BasicConv(448, (1, 1), name="branch3x3dbl_1")(x)
+        bd = BasicConv(384, (3, 3), padding=[(1, 1), (1, 1)],
+                       name="branch3x3dbl_2")(bd)
+        bda = BasicConv(384, (1, 3), padding=[(0, 0), (1, 1)],
+                        name="branch3x3dbl_3a")(bd)
+        bdb = BasicConv(384, (3, 1), padding=[(1, 1), (0, 0)],
+                        name="branch3x3dbl_3b")(bd)
+        bd = jnp.concatenate([bda, bdb], axis=-1)
+        bp = _pool(x, 3, 1, "SAME", self.pool_kind)
+        bp = BasicConv(192, (1, 1), name="branch_pool")(bp)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3Features(nn.Module):
+    """Images [N, H, W, 3] in [0, 1] -> pool3 features [N, 2048]."""
+
+    resize_input: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        if self.resize_input and x.shape[1:3] != (299, 299):
+            x = jax.image.resize(
+                x, (x.shape[0], 299, 299, x.shape[3]), "bilinear")
+        x = 2.0 * x - 1.0     # [0,1] -> [-1,1] (pytorch-FID normalization)
+        x = BasicConv(32, (3, 3), strides=(2, 2), name="Conv2d_1a_3x3")(x)
+        x = BasicConv(32, (3, 3), name="Conv2d_2a_3x3")(x)
+        x = BasicConv(64, (3, 3), padding=[(1, 1), (1, 1)],
+                      name="Conv2d_2b_3x3")(x)
+        x = _pool(x, 3, 2)
+        x = BasicConv(80, (1, 1), name="Conv2d_3b_1x1")(x)
+        x = BasicConv(192, (3, 3), name="Conv2d_4a_3x3")(x)
+        x = _pool(x, 3, 2)
+        x = InceptionA(32, name="Mixed_5b")(x)
+        x = InceptionA(64, name="Mixed_5c")(x)
+        x = InceptionA(64, name="Mixed_5d")(x)
+        x = InceptionB(name="Mixed_6a")(x)
+        x = InceptionC(128, name="Mixed_6b")(x)
+        x = InceptionC(160, name="Mixed_6c")(x)
+        x = InceptionC(160, name="Mixed_6d")(x)
+        x = InceptionC(192, name="Mixed_6e")(x)
+        x = InceptionD(name="Mixed_7a")(x)
+        x = InceptionE("avg", name="Mixed_7b")(x)
+        x = InceptionE("max", name="Mixed_7c")(x)
+        return jnp.mean(x, axis=(1, 2))   # global average pool -> [N, 2048]
+
+
+def make_inception_extractor(params_file: Optional[str] = None,
+                             seed: int = 0):
+    """Build `extractor(images) -> [N, 2048]` for FIDComputer.
+
+    `params_file`: local .npz of flattened '/'-joined param paths (FID
+    weights; no download path exists in this environment). Without it the
+    network is random-init — deterministic per seed, usable as a fixed
+    random-feature extractor for relative comparisons, NOT standard FID.
+    """
+    model = InceptionV3Features()
+    dummy = jnp.zeros((1, 299, 299, 3))
+    variables = model.init(jax.random.PRNGKey(seed), dummy)
+    if params_file is not None:
+        loaded = np.load(params_file)
+        flat = {tuple(k.split("/")): jnp.asarray(v)
+                for k, v in loaded.items()}
+        variables = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(variables),
+            [flat[p] for p in sorted(flat)])
+
+    @jax.jit
+    def extractor(images):
+        images = jnp.asarray(images)
+        if images.dtype == jnp.uint8:
+            images = images.astype(jnp.float32) / 255.0
+        return model.apply(variables, images)
+
+    return extractor

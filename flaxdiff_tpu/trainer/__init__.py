@@ -7,9 +7,12 @@ state sharded on the `fsdp` axis, batch on `data`), donated state, EMA as
 a sharded pytree update, CFG dropout by `jnp.where` null-embedding mask,
 and no per-step host sync (loss is read back only at the log cadence).
 """
+from .checkpoints import Checkpointer, abstract_state_like
+from .logging import JsonlLogger, MultiLogger, WandbLogger, make_logger
 from .train_state import TrainState
 from .train_step import TrainStepConfig, make_train_step
 from .trainer import DiffusionTrainer, TrainerConfig
+from .validation import ValidationConfig, Validator
 
 __all__ = [
     "TrainState",
@@ -17,4 +20,12 @@ __all__ = [
     "make_train_step",
     "DiffusionTrainer",
     "TrainerConfig",
+    "Checkpointer",
+    "abstract_state_like",
+    "ValidationConfig",
+    "Validator",
+    "JsonlLogger",
+    "WandbLogger",
+    "MultiLogger",
+    "make_logger",
 ]

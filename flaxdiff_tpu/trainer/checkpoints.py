@@ -1,0 +1,103 @@
+"""Orbax sharded async checkpointing bound to NamedSharding state.
+
+The reference gathers the full state to host numpy and saves replicated
+trees (simple_trainer.py:369-389 via get_np_tree) — its main scalability
+gap (SURVEY.md §5.4). Here state stays device-sharded: orbax's OCDBT
+backend writes each host's shards in parallel and restore places shards
+directly onto the mesh via the saved-state's shardings.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from ..typing import PyTree
+
+
+class Checkpointer:
+    """Async sharded checkpoint manager (reference
+    simple_trainer.py:230-235, 339-389).
+
+    Payload: {"state": TrainState, "meta": {best_loss, ...}}.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 save_interval_steps: int = 1):
+        directory = os.path.abspath(os.path.expanduser(directory)) \
+            if "://" not in directory else directory
+        self._mgr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    @property
+    def directory(self) -> str:
+        return str(self._mgr.directory)
+
+    def save(self, step: int, state: PyTree,
+             meta: Optional[dict] = None, force: bool = False) -> bool:
+        """Async sharded save; returns True if a save was started. A step
+        that already exists is skipped (orbax refuses to overwrite a step
+        even with force=True)."""
+        if step in self._mgr.all_steps():
+            return False
+        # meta is always written so restore can unconditionally request it.
+        return self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                meta=ocp.args.JsonSave(dict(meta or {}))),
+            force=force)
+
+    def restore(self, abstract_state: PyTree,
+                step: Optional[int] = None) -> tuple:
+        """Restore (state, meta). `abstract_state` is a jax.eval_shape-style
+        tree of ShapeDtypeStruct with shardings attached — shards land
+        directly on their devices."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}")
+        try:
+            restored = self._mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(abstract_state),
+                    meta=ocp.args.JsonRestore(),
+                ))
+        except KeyError:
+            # checkpoint written without a meta item (external writer)
+            restored = self._mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(abstract_state)))
+        return restored["state"], (restored.get("meta") or {})
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return list(self._mgr.all_steps())
+
+    def wait_until_finished(self):
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.close()
+
+
+def abstract_state_like(state: PyTree) -> PyTree:
+    """ShapeDtypeStruct tree with shardings copied from a live state —
+    the `abstract_state` input for Checkpointer.restore."""
+    def absify(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        return x
+    return jax.tree_util.tree_map(absify, state)

@@ -1,0 +1,94 @@
+"""Training loggers: wandb when available, JSONL always.
+
+wandb is the reference's system of record (simple_trainer.py:189-227,
+579-594) but is a hard dependency there; here logging is a small protocol
+with a JSONL file logger as the load-bearing default and a wandb adapter
+gated on import.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional, Sequence
+
+
+class JsonlLogger:
+    """Appends one JSON object per log call — greppable, dependency-free."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                    exist_ok=True)
+        self.path = path
+        self._fh = open(path, "a", buffering=1)
+
+    def log(self, data: Dict[str, Any], step: Optional[int] = None):
+        rec = {"_time": time.time()}
+        if step is not None:
+            rec["step"] = step
+        rec.update({k: v for k, v in data.items()
+                    if isinstance(v, (int, float, str, bool, type(None)))})
+        self._fh.write(json.dumps(rec) + "\n")
+
+    def log_images(self, key: str, images, step: Optional[int] = None):
+        self.log({key: f"<{getattr(images, 'shape', '?')} images>"}, step)
+
+    def finish(self):
+        self._fh.close()
+
+
+class WandbLogger:
+    """wandb adapter; raises at construction if wandb is unavailable."""
+
+    def __init__(self, project: str, name: Optional[str] = None,
+                 config: Optional[dict] = None, **kwargs):
+        import wandb  # gated optional dependency
+        self._wandb = wandb
+        self.run = wandb.init(project=project, name=name, config=config,
+                              **kwargs)
+
+    def log(self, data: Dict[str, Any], step: Optional[int] = None):
+        self.run.log(data, step=step)
+
+    def log_images(self, key: str, images, step: Optional[int] = None):
+        self.run.log({key: [self._wandb.Image(im) for im in images]},
+                     step=step)
+
+    def finish(self):
+        self.run.finish()
+
+
+class MultiLogger:
+    """Fan-out to several loggers."""
+
+    def __init__(self, loggers: Sequence[Any]):
+        self.loggers = list(loggers)
+
+    def log(self, data, step=None):
+        for lg in self.loggers:
+            lg.log(data, step=step)
+
+    def log_images(self, key, images, step=None):
+        for lg in self.loggers:
+            lg.log_images(key, images, step=step)
+
+    def finish(self):
+        for lg in self.loggers:
+            lg.finish()
+
+
+def make_logger(project: Optional[str] = None,
+                jsonl_path: Optional[str] = None, **wandb_kwargs):
+    """Best-available logger: wandb if installed and project given,
+    JSONL otherwise (both when both requested)."""
+    loggers = []
+    if jsonl_path:
+        loggers.append(JsonlLogger(jsonl_path))
+    if project:
+        try:
+            loggers.append(WandbLogger(project=project, **wandb_kwargs))
+        except ImportError:
+            pass
+    if not loggers:
+        loggers.append(JsonlLogger("train_log.jsonl"))
+    return loggers[0] if len(loggers) == 1 else MultiLogger(loggers)

@@ -105,6 +105,34 @@ class DiffusionTrainer:
         self.best_loss = float("inf")
         self.best_state: Optional[TrainState] = None
 
+    # -- checkpointing -------------------------------------------------------
+    def save_checkpoint(self, force: bool = False) -> bool:
+        """Sharded async save of the live state (+best_loss meta)."""
+        if self.checkpointer is None:
+            return False
+        step = int(jax.device_get(self.state.step))
+        return self.checkpointer.save(
+            step, self.state, meta={"best_loss": float(self.best_loss)},
+            force=force)
+
+    def restore_checkpoint(self, step: Optional[int] = None) -> int:
+        """Restore state (sharded, shards placed directly on the mesh);
+        returns the restored step (reference simple_trainer.py:339-367)."""
+        if self.checkpointer is None:
+            raise ValueError("trainer has no checkpointer")
+        from .checkpoints import abstract_state_like
+        abstract = abstract_state_like(self.state)
+        self.state, meta = self.checkpointer.restore(abstract, step=step)
+        best = float(meta.get("best_loss", float("inf")))
+        # best_loss == 0 is the reference's corrupt-checkpoint sentinel
+        # (simple_trainer.py:352) — reset rather than trust it.
+        self.best_loss = best if best > 0 else float("inf")
+        # Seed best_state from the restored state so NaN rollback stays
+        # armed after resume (the restored best_loss may never be beaten).
+        if self.config.keep_best_state:
+            self.best_state = jax.tree_util.tree_map(jnp.copy, self.state)
+        return int(jax.device_get(self.state.step))
+
     # -- data movement -------------------------------------------------------
     def put_batch(self, batch: PyTree) -> PyTree:
         """Host-local numpy batch -> global sharded jax arrays."""
@@ -167,13 +195,10 @@ class DiffusionTrainer:
                         jnp.copy, self.state)
                 log_t0 = time.perf_counter()
 
-            if save_every and (i + 1) % save_every == 0 and self.checkpointer:
-                self.checkpointer.save(int(jax.device_get(self.state.step)),
-                                       self.state)
+            if save_every and (i + 1) % save_every == 0:
+                self.save_checkpoint()
 
-        if self.checkpointer:
-            self.checkpointer.save(int(jax.device_get(self.state.step)),
-                                   self.state)
+        self.save_checkpoint(force=True)
         history["final_loss"] = losses[-1] if losses else float("nan")
         history["best_loss"] = self.best_loss
         return history

@@ -1,0 +1,162 @@
+"""Tests: sharded checkpoint save/restore/resume, validation, logging."""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from flaxdiff_tpu.metrics import EvaluationMetric, MetricTracker
+from flaxdiff_tpu.predictors import EpsilonPredictionTransform
+from flaxdiff_tpu.schedulers import CosineNoiseSchedule
+from flaxdiff_tpu.trainer import (
+    Checkpointer,
+    DiffusionTrainer,
+    JsonlLogger,
+    TrainerConfig,
+    ValidationConfig,
+    Validator,
+)
+from flaxdiff_tpu.models.unet import Unet
+
+
+def _make_trainer(mesh, tmp_path=None):
+    model = Unet(output_channels=1, emb_features=16, feature_depths=(8, 12),
+                 num_res_blocks=1, norm_groups=4, attention_configs=(None, None))
+    x0 = jnp.zeros((2, 8, 8, 1))
+    t0 = jnp.zeros((2,))
+
+    def apply_fn(params, x, t, cond):
+        return model.apply(params, x, t, None)
+
+    def init_fn(key):
+        return model.init(key, x0, t0, None)
+
+    ckpt = Checkpointer(str(tmp_path), max_to_keep=2) if tmp_path else None
+    return DiffusionTrainer(
+        apply_fn=apply_fn, init_fn=init_fn, tx=optax.adam(1e-3),
+        schedule=CosineNoiseSchedule(timesteps=100),
+        transform=EpsilonPredictionTransform(),
+        mesh=mesh, config=TrainerConfig(normalize=False, log_every=2),
+        checkpointer=ckpt)
+
+
+def _batches(n, rng):
+    for _ in range(n):
+        yield {"sample": rng.normal(size=(8, 8, 8, 1)).astype(np.float32)}
+
+
+def test_checkpoint_roundtrip(mesh, tmp_path, rng):
+    trainer = _make_trainer(mesh, tmp_path / "ckpt")
+    data = _batches(4, rng)
+    trainer.fit(data, total_steps=4)
+    trainer.checkpointer.wait_until_finished()
+    saved_step = trainer.checkpointer.latest_step()
+    assert saved_step == 4
+
+    # Fresh trainer restores the exact sharded state.
+    trainer2 = _make_trainer(mesh, tmp_path / "ckpt")
+    restored_step = trainer2.restore_checkpoint()
+    assert restored_step == 4
+    p1 = jax.device_get(trainer.state.params)
+    p2 = jax.device_get(trainer2.state.params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)), p1, p2)
+    # Restored state keeps its FSDP shardings.
+    leaf = jax.tree_util.tree_leaves(trainer2.state.params)[0]
+    assert leaf.sharding.mesh.axis_names == mesh.axis_names
+    trainer.checkpointer.close()
+    trainer2.checkpointer.close()
+
+
+def test_checkpoint_resume_continues_training(mesh, tmp_path, rng):
+    trainer = _make_trainer(mesh, tmp_path / "ckpt2")
+    trainer.fit(_batches(3, rng), total_steps=3)
+    trainer.checkpointer.wait_until_finished()
+
+    trainer2 = _make_trainer(mesh, tmp_path / "ckpt2")
+    trainer2.restore_checkpoint()
+    trainer2.fit(_batches(2, rng), total_steps=2)
+    assert int(jax.device_get(trainer2.state.step)) == 5
+    trainer2.checkpointer.wait_until_finished()
+    assert trainer2.checkpointer.latest_step() == 5
+    trainer.checkpointer.close()
+    trainer2.checkpointer.close()
+
+
+def test_fit_with_save_every_equal_total_steps(mesh, tmp_path, rng):
+    """Final forced save must not crash when save_every already wrote the
+    last step (orbax refuses duplicate steps)."""
+    trainer = _make_trainer(mesh, tmp_path / "ckpt3")
+    hist = trainer.fit(_batches(4, rng), total_steps=4, save_every=2)
+    assert "final_loss" in hist
+    trainer.checkpointer.wait_until_finished()
+    assert trainer.checkpointer.latest_step() == 4
+    trainer.checkpointer.close()
+
+
+def test_restore_arms_best_state(mesh, tmp_path, rng):
+    trainer = _make_trainer(mesh, tmp_path / "ckpt4")
+    trainer.fit(_batches(3, rng), total_steps=3)
+    trainer.checkpointer.wait_until_finished()
+    trainer2 = _make_trainer(mesh, tmp_path / "ckpt4")
+    trainer2.restore_checkpoint()
+    assert trainer2.best_state is not None  # NaN rollback armed after resume
+    trainer.checkpointer.close()
+    trainer2.checkpointer.close()
+
+
+def test_restore_without_checkpoint_raises(mesh, tmp_path):
+    trainer = _make_trainer(mesh, tmp_path / "empty")
+    with pytest.raises(FileNotFoundError):
+        trainer.restore_checkpoint()
+    trainer.checkpointer.close()
+
+
+def test_metric_tracker_directions():
+    tr = MetricTracker()
+    assert tr.update("fid", 30.0, higher_is_better=False)
+    assert not tr.update("fid", 40.0, higher_is_better=False)
+    assert tr.update("fid", 20.0, higher_is_better=False)
+    assert tr.update("clip", 0.2, higher_is_better=True)
+    assert tr.update("clip", 0.3, higher_is_better=True)
+    assert tr.best == {"fid": 20.0, "clip": 0.3}
+
+
+def test_validator_runs_metrics(mesh, rng):
+    trainer = _make_trainer(mesh)
+
+    def model_fn(params, x, t, cond):
+        return trainer._apply_fn(params, x, t, cond)
+
+    mean_abs = EvaluationMetric(
+        function=lambda samples, batch: float(np.abs(samples).mean()),
+        name="mean_abs", higher_is_better=False)
+    validator = Validator(
+        model_fn=model_fn, schedule=trainer.schedule,
+        transform=trainer.transform,
+        config=ValidationConfig(num_samples=4, diffusion_steps=5,
+                                resolution=8, channels=1, guidance_scale=0.0),
+        metrics=[mean_abs])
+    out = validator.run(trainer.get_params())
+    assert out["samples"].shape == (4, 8, 8, 1)
+    assert "mean_abs" in out["metrics"]
+    assert out["improved"]["mean_abs"] is True
+    # Second run with same params: not an improvement (equal value).
+    out2 = validator.run(trainer.get_params())
+    assert out2["improved"]["mean_abs"] is False
+
+
+def test_jsonl_logger(tmp_path):
+    path = str(tmp_path / "log.jsonl")
+    lg = JsonlLogger(path)
+    lg.log({"loss": 0.5, "skip": [1, 2]}, step=10)
+    lg.log({"loss": 0.25}, step=20)
+    lg.finish()
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0]["step"] == 10 and lines[0]["loss"] == 0.5
+    assert "skip" not in lines[0]
+    assert lines[1]["loss"] == 0.25

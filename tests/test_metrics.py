@@ -1,0 +1,89 @@
+"""Tests for FID machinery, Inception features, CLIP math."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flaxdiff_tpu.metrics import (
+    FeatureStats,
+    FIDComputer,
+    clip_score,
+    cosine_similarity,
+    frechet_distance,
+    make_inception_extractor,
+)
+
+
+def test_feature_stats_matches_numpy(rng):
+    x = rng.normal(size=(100, 8))
+    st = FeatureStats()
+    st.update(x[:30])
+    st.update(x[30:])
+    np.testing.assert_allclose(st.mean, x.mean(axis=0), rtol=1e-10)
+    np.testing.assert_allclose(st.cov, np.cov(x, rowvar=False), rtol=1e-8)
+
+
+def test_frechet_distance_identity_is_zero(rng):
+    x = rng.normal(size=(200, 6))
+    mu, cov = x.mean(0), np.cov(x, rowvar=False)
+    assert abs(frechet_distance(mu, cov, mu, cov)) < 1e-6
+
+
+def test_frechet_distance_mean_shift():
+    d = 4
+    mu1, cov = np.zeros(d), np.eye(d)
+    mu2 = np.ones(d) * 2.0
+    # identical covariances: FID = |mu1-mu2|^2 = 16
+    np.testing.assert_allclose(frechet_distance(mu1, cov, mu2, cov), 16.0,
+                               rtol=1e-8)
+
+
+def test_frechet_distance_known_covariance():
+    # 1-D: FID = (m1-m2)^2 + s1 + s2 - 2 sqrt(s1 s2)
+    v = frechet_distance(np.array([0.0]), np.array([[4.0]]),
+                         np.array([1.0]), np.array([[1.0]]))
+    np.testing.assert_allclose(v, 1.0 + 4 + 1 - 2 * 2.0, rtol=1e-8)
+
+
+def test_fid_computer_discriminates(rng):
+    """Same-distribution FID should be far below shifted-distribution FID."""
+    def extractor(images):
+        return np.asarray(images).reshape(len(images), -1)[:, :16]
+
+    base = rng.normal(size=(300, 4, 4, 1))
+    same = rng.normal(size=(300, 4, 4, 1))
+    shifted = rng.normal(size=(300, 4, 4, 1)) + 3.0
+
+    fid = FIDComputer(extractor, batch_size=128)
+    fid.add_real(base)
+    fid.add_generated(same)
+    fid_same = fid.compute()
+    fid.reset_generated()
+    fid.add_generated(shifted)
+    fid_shifted = fid.compute()
+    assert fid_shifted > 50 * max(fid_same, 1e-3)
+
+
+def test_fid_needs_samples():
+    fid = FIDComputer(lambda x: np.asarray(x).reshape(len(x), -1))
+    with pytest.raises(ValueError):
+        fid.compute()
+
+
+@pytest.mark.slow
+def test_inception_forward_shape(rng):
+    extractor = make_inception_extractor()
+    imgs = rng.uniform(size=(2, 64, 64, 3)).astype(np.float32)
+    feats = np.asarray(extractor(imgs))
+    assert feats.shape == (2, 2048)
+    assert np.all(np.isfinite(feats))
+    # deterministic
+    np.testing.assert_array_equal(feats, np.asarray(extractor(imgs)))
+
+
+def test_cosine_similarity_and_clip_score():
+    a = jnp.asarray([[1.0, 0.0], [0.0, 2.0], [1.0, 1.0]])
+    b = jnp.asarray([[2.0, 0.0], [0.0, -1.0], [1.0, 1.0]])
+    cs = np.asarray(cosine_similarity(a, b))
+    np.testing.assert_allclose(cs, [1.0, -1.0, 1.0], atol=1e-6)
+    sc = np.asarray(clip_score(a, b))
+    np.testing.assert_allclose(sc, [2.5, 0.0, 2.5], atol=1e-5)
