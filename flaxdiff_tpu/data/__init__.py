@@ -1,0 +1,34 @@
+"""Data pipeline (capability parity: reference flaxdiff/data/).
+
+Layers: sources (indexable record access) -> augmenters (per-sample
+transforms) -> grain loader assembly (sharded, multi-worker, collated)
+-> host-numpy batch iterators consumed by DiffusionTrainer.put_batch.
+The online HTTP streaming loader mirrors reference data/online_loader.py
+with an injectable fetcher so it is testable offline.
+"""
+from .dataloaders import get_dataset_grain, make_batch_iterator
+from .dataset_map import DATASET_REGISTRY, register_dataset
+from .online_loader import OnlineStreamingDataLoader
+from .sources.base import DataAugmenter, DataSource, MediaDataset
+from .sources.images import (
+    ImageAugmenter,
+    MemoryImageSource,
+    prompt_templates_for_class,
+)
+from .sources.videos import VideoClipAugmenter, VideoFolderSource
+
+__all__ = [
+    "DataSource",
+    "DataAugmenter",
+    "MediaDataset",
+    "MemoryImageSource",
+    "ImageAugmenter",
+    "prompt_templates_for_class",
+    "VideoFolderSource",
+    "VideoClipAugmenter",
+    "get_dataset_grain",
+    "make_batch_iterator",
+    "OnlineStreamingDataLoader",
+    "DATASET_REGISTRY",
+    "register_dataset",
+]

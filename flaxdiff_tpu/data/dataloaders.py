@@ -1,0 +1,186 @@
+"""Grain loader assembly + collation.
+
+Capability parity with reference flaxdiff/data/dataloaders.py:261-640
+(get_dataset_grain: IndexSampler sharded by jax process, worker processes,
+shape-normalizing collate with fallback dummy batches, per-process batch
+slicing). The trainer consumes host-local numpy batches and builds global
+arrays itself (DiffusionTrainer.put_batch), so loaders here stop at the
+host boundary — no per-step device sync.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+
+from .sources.base import DataAugmenter, DataSource, MediaDataset
+
+
+def collate(samples, sample_key: str = "image") -> Dict[str, Any]:
+    """Stack sample dicts into a batch dict; tokenized text stacks per
+    sub-key (reference dataloaders.py:85-252)."""
+    if not samples:
+        raise ValueError("empty batch")
+    batch: Dict[str, Any] = {}
+    first = samples[0]
+    for key in first:
+        vals = [s[key] for s in samples]
+        if isinstance(first[key], dict):
+            batch[key] = {k: np.stack([v[k] for v in vals])
+                          for k in first[key]}
+        elif isinstance(first[key], str):
+            batch[key] = list(vals)
+        else:
+            batch[key] = np.stack(vals)
+    return batch
+
+
+def _destring(batch: Dict[str, Any]) -> Dict[str, Any]:
+    """Convert numpy unicode arrays (grain's stacked strings) to lists."""
+    def fix(v):
+        if isinstance(v, dict):
+            return {k: fix(x) for k, x in v.items()}
+        if isinstance(v, np.ndarray) and v.dtype.kind in ("U", "S"):
+            return [str(s) for s in v.tolist()]
+        return v
+    return {k: fix(v) for k, v in batch.items()}
+
+
+def to_trainer_batch(batch: Dict[str, Any]) -> Dict[str, Any]:
+    """Rename the media key to the trainer's contract: train_step reads
+    batch["sample"] (train_step.py:57) and conditioning under "cond"."""
+    out: Dict[str, Any] = {}
+    for key, v in batch.items():
+        if key in ("image", "video"):
+            out["sample"] = v
+        elif key == "text" and not isinstance(v, list):
+            out.setdefault("cond", {})["text"] = v
+        else:
+            out[key] = v
+    return out
+
+
+def fallback_batch(reference_batch: Dict[str, Any]) -> Dict[str, Any]:
+    """Zero-filled batch with the same structure — injected when a batch
+    fails to decode (reference dataloaders.py:203-247)."""
+    def zero(v):
+        if isinstance(v, dict):
+            return {k: zero(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [""] * len(v)
+        return np.zeros_like(v)
+    return {k: zero(v) for k, v in reference_batch.items()}
+
+
+@dataclasses.dataclass
+class GrainLoader:
+    """Restartable epoch iterator over a grain DataLoader. Batches come
+    out in trainer contract form ({"sample": ..., "cond"/"text": ...})."""
+
+    make_loader: Callable[[int], Any]     # seed -> grain DataLoader
+    batches_per_epoch: int
+
+    def __call__(self, seed: int = 0) -> Iterator[Dict[str, Any]]:
+        last_good: Optional[Dict[str, Any]] = None
+        epoch = 0
+        while True:
+            it = iter(self.make_loader(seed + epoch))
+            while True:
+                try:
+                    batch = to_trainer_batch(_destring(next(it)))
+                except StopIteration:
+                    break
+                except Exception:
+                    # decode/transform failure: keep the loop fed
+                    # (reference dataloaders.py:203-247)
+                    if last_good is None:
+                        continue
+                    batch = fallback_batch(last_good)
+                last_good = batch
+                yield batch
+            epoch += 1
+
+
+def get_dataset_grain(dataset: MediaDataset,
+                      batch_size: int,
+                      image_size: int = 64,
+                      worker_count: int = 0,
+                      seed: int = 0,
+                      num_epochs: Optional[int] = None,
+                      drop_remainder: bool = True,
+                      augment_kwargs: Optional[dict] = None) -> Dict[str, Any]:
+    """Assemble the sharded grain pipeline for one MediaDataset.
+
+    Returns {"train": callable -> iterator, "train_len": n_records,
+    "local_batch_size": per-process batch} (reference
+    dataloaders.py:261-349).
+    """
+    import grain.python as pygrain
+
+    source = dataset.get_source()
+    transform = dataset.get_augmenter(
+        image_size=image_size, **(augment_kwargs or {}))
+    filt = dataset.augmenter.create_filter()
+
+    if batch_size % jax.process_count():
+        raise ValueError(
+            f"batch {batch_size} not divisible by {jax.process_count()} "
+            "processes")
+    local_bs = batch_size // jax.process_count()
+
+    class _Map(pygrain.RandomMapTransform):
+        def random_map(self, record, rng: np.random.Generator):
+            return transform(record, rng=rng)
+
+    ops = []
+    if filt is not None:
+        class _Filter(pygrain.FilterTransform):
+            def filter(self, record) -> bool:
+                return filt(record)
+        ops.append(_Filter())
+    ops.append(_Map())
+    # grain's Batch stacks every leaf (strings become <U numpy arrays);
+    # GrainLoader converts string arrays back to lists downstream.
+    ops.append(pygrain.Batch(batch_size=local_bs,
+                             drop_remainder=drop_remainder))
+
+    def make_loader(epoch_seed: int):
+        sampler = pygrain.IndexSampler(
+            num_records=len(source),
+            shuffle=True,
+            seed=epoch_seed,
+            num_epochs=1,
+            shard_options=pygrain.ShardByJaxProcess(drop_remainder=True),
+        )
+        return pygrain.DataLoader(
+            data_source=source,
+            sampler=sampler,
+            operations=ops,
+            worker_count=worker_count,
+        )
+
+    n = len(source) // jax.process_count()
+    return {
+        "train": GrainLoader(make_loader, max(n // local_bs, 1)),
+        "train_len": len(source),
+        "local_batch_size": local_bs,
+        "global_batch_size": batch_size,
+    }
+
+
+def make_batch_iterator(images: np.ndarray,
+                        batch_size: int,
+                        labels=None,
+                        seed: int = 0) -> Iterator[Dict[str, Any]]:
+    """Minimal in-memory infinite batch iterator (no grain) for quick runs
+    and benchmarks."""
+    rng = np.random.default_rng(seed)
+    n = len(images)
+    while True:
+        idx = rng.integers(0, n, size=batch_size)
+        batch = {"sample": np.asarray(images[idx])}
+        if labels is not None:
+            batch["text"] = [labels[i] for i in idx]
+        yield batch

@@ -1,0 +1,67 @@
+"""Dataset registry (reference flaxdiff/data/dataset_map.py:19-174).
+
+Maps dataset names to MediaDataset factories. The reference hardcodes its
+GCS/TFDS corpus table; here the registry is open (register_dataset) with
+hermetic built-ins, and at-scale entries are added by user code or the CLI.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import numpy as np
+
+from .sources.base import MediaDataset
+from .sources.images import HFImageSource, ImageAugmenter, MemoryImageSource
+from .sources.videos import VideoClipAugmenter, VideoFolderSource
+
+DATASET_REGISTRY: Dict[str, Callable[..., MediaDataset]] = {}
+
+
+def register_dataset(name: str):
+    def deco(fn: Callable[..., MediaDataset]):
+        DATASET_REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def get_dataset(name: str, **kwargs) -> MediaDataset:
+    if name not in DATASET_REGISTRY:
+        raise ValueError(f"unknown dataset {name!r}; "
+                         f"known: {sorted(DATASET_REGISTRY)}")
+    return DATASET_REGISTRY[name](**kwargs)
+
+
+@register_dataset("synthetic")
+def _synthetic(n: int = 256, image_size: int = 64, seed: int = 0,
+               **kwargs) -> MediaDataset:
+    """Deterministic two-mode toy distribution — CI / smoke runs."""
+    rng = np.random.default_rng(seed)
+    signs = rng.choice([0.0, 1.0], size=(n, 1, 1, 1))
+    imgs = (signs * 160 + 40 + rng.normal(size=(n, image_size, image_size, 3))
+            * 10).clip(0, 255).astype(np.uint8)
+    labels = ["bright" if s else "dark" for s in signs[:, 0, 0, 0]]
+    return MediaDataset(source=MemoryImageSource(images=imgs, labels=labels),
+                        augmenter=ImageAugmenter(image_size=image_size),
+                        media_type="image")
+
+
+@register_dataset("oxford_flowers102")
+def _flowers(image_size: int = 64, split: str = "train",
+             **kwargs) -> MediaDataset:
+    """Oxford Flowers via HF datasets (reference uses TFDS,
+    dataset_map.py:19-30); network-gated."""
+    return MediaDataset(
+        source=HFImageSource("nelorth/oxford-flowers", split=split),
+        augmenter=ImageAugmenter(image_size=image_size,
+                                 caption_from_class=True),
+        media_type="image")
+
+
+@register_dataset("video_folder")
+def _video_folder(root: str, image_size: int = 64, num_frames: int = 8,
+                  **kwargs) -> MediaDataset:
+    return MediaDataset(
+        source=VideoFolderSource(root=root),
+        augmenter=VideoClipAugmenter(num_frames=num_frames,
+                                     image_size=image_size),
+        media_type="video")

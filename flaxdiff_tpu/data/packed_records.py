@@ -1,0 +1,188 @@
+"""Packed-record files: Python writer + native (C++/mmap) reader.
+
+First-party replacement for the role the grain C++ ArrayRecord reader
+plays in the reference data layer (data/sources/images.py:219-270): large
+image corpora packed into flat record files read with zero-copy mmap
+access from native code. Records are dicts of named byte arrays using the
+same byte-packed layout the reference decodes
+(images.py:20-38 unpack_dict_of_byte_arrays):
+  [u32 n] then n * ([u16 keylen][key utf8][u64 vallen][val bytes]).
+"""
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import os
+import shutil
+import struct
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from .sources.base import DataAugmenter, DataSource
+
+MAGIC = b"FDTR"
+VERSION = 1
+
+
+def pack_record(entries: Dict[str, bytes]) -> bytes:
+    """Serialize a dict of byte strings."""
+    out = [struct.pack("<I", len(entries))]
+    for key, val in entries.items():
+        kb = key.encode("utf-8")
+        out.append(struct.pack("<H", len(kb)))
+        out.append(kb)
+        out.append(struct.pack("<Q", len(val)))
+        out.append(bytes(val))
+    return b"".join(out)
+
+
+def unpack_record(data: bytes) -> Dict[str, bytes]:
+    """Inverse of pack_record (reference images.py:20-38 semantics)."""
+    n, = struct.unpack_from("<I", data, 0)
+    pos = 4
+    out: Dict[str, bytes] = {}
+    for _ in range(n):
+        klen, = struct.unpack_from("<H", data, pos)
+        pos += 2
+        key = data[pos:pos + klen].decode("utf-8")
+        pos += klen
+        vlen, = struct.unpack_from("<Q", data, pos)
+        pos += 8
+        out[key] = data[pos:pos + vlen]
+        pos += vlen
+    return out
+
+
+class PackedRecordWriter:
+    """Streams records to disk as they arrive (payload goes to a temp file;
+    only the 16-byte-per-record index stays in memory), then assembles
+    header + index + payload at close — corpus-sized datasets never need
+    corpus-sized RAM."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._payload_path = f"{path}.payload.{os.getpid()}.tmp"
+        self._payload = open(self._payload_path, "wb")
+        self._offsets: List[int] = []
+        self._lengths: List[int] = []
+        self._pos = 0
+        self._closed = False
+
+    def write(self, record: Dict[str, bytes] | bytes):
+        if self._closed:
+            raise ValueError("writer closed")
+        blob = record if isinstance(record, (bytes, bytearray)) \
+            else pack_record(record)
+        self._offsets.append(self._pos)
+        self._lengths.append(len(blob))
+        self._payload.write(blob)
+        self._pos += len(blob)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._payload.close()
+        n = len(self._offsets)
+        try:
+            with open(self.path, "wb") as f:
+                f.write(MAGIC)
+                f.write(struct.pack("<I", VERSION))
+                f.write(struct.pack("<Q", n))
+                for off, length in zip(self._offsets, self._lengths):
+                    f.write(struct.pack("<QQ", off, length))
+                with open(self._payload_path, "rb") as payload:
+                    shutil.copyfileobj(payload, f, length=16 * 1024 * 1024)
+        finally:
+            os.unlink(self._payload_path)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class PackedRecordReader:
+    """Native mmap reader; zero-copy record access via memoryview."""
+
+    def __init__(self, path: str):
+        from ..native import load_packed_reader
+        self._lib = load_packed_reader()
+        self._handle = self._lib.pr_open(path.encode("utf-8"))
+        if not self._handle:
+            raise IOError(f"could not open packed record file {path!r}")
+        self.path = path
+
+    def __len__(self) -> int:
+        return int(self._lib.pr_num_records(self._handle))
+
+    def record_bytes(self, idx: int) -> bytes:
+        idx = int(idx)
+        if not 0 <= idx < len(self):
+            raise IndexError(f"record {idx} out of range (n={len(self)})")
+        length = int(self._lib.pr_record_length(self._handle, idx))
+        if length == 0:
+            return b""
+        return ctypes.string_at(self._lib.pr_record_ptr(self._handle, idx),
+                                length)
+
+    def __getitem__(self, idx: int) -> Dict[str, bytes]:
+        return unpack_record(self.record_bytes(idx))
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.pr_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+@dataclasses.dataclass
+class PackedRecordSource(DataSource):
+    """DataSource over a packed record file; decodes the standard
+    image/text entries (image bytes via cv2, caption utf-8)."""
+
+    path: str
+
+    def get_source(self, path_override: Optional[str] = None):
+        reader = PackedRecordReader(path_override or self.path)
+
+        class _Src:
+            def __len__(self):
+                return len(reader)
+
+            def __getitem__(self, i):
+                entries = reader[int(i)]
+                rec: Dict[str, Any] = {}
+                if "image" in entries:
+                    from .online_loader import decode_image
+                    rec["image"] = decode_image(entries["image"])
+                if "caption" in entries:
+                    rec["text"] = entries["caption"].decode("utf-8")
+                return rec
+
+        return _Src()
+
+
+def write_image_dataset(path: str, images: Iterable[np.ndarray],
+                        captions: Optional[Iterable[str]] = None,
+                        format: str = ".png"):
+    """Pack an image (+caption) dataset into one record file."""
+    import cv2
+    captions = list(captions) if captions is not None else None
+    with PackedRecordWriter(path) as w:
+        for i, img in enumerate(images):
+            ok, enc = cv2.imencode(
+                format, cv2.cvtColor(np.asarray(img), cv2.COLOR_RGB2BGR))
+            if not ok:
+                raise ValueError(f"could not encode image {i}")
+            rec = {"image": enc.tobytes()}
+            if captions is not None:
+                rec["caption"] = captions[i].encode("utf-8")
+            w.write(rec)

@@ -1,0 +1,3 @@
+from .base import DataAugmenter, DataSource, MediaDataset
+
+__all__ = ["DataSource", "DataAugmenter", "MediaDataset"]

@@ -1,0 +1,61 @@
+"""Source / augmenter ABCs (reference flaxdiff/data/sources/base.py:8-141).
+
+A DataSource yields raw records by index (grain RandomAccessDataSource
+protocol: __len__ + __getitem__); a DataAugmenter builds the per-sample
+transform and an optional filter; MediaDataset pairs them.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+
+class DataSource(ABC):
+    """Random-access record source."""
+
+    @abstractmethod
+    def get_source(self, path_override: Optional[str] = None):
+        """Return an indexable (len + getitem) over raw records."""
+        ...
+
+    @staticmethod
+    def create(source_type: str, **kwargs) -> "DataSource":
+        from .images import MemoryImageSource
+        from .videos import VideoFolderSource
+        registry = {
+            "memory": MemoryImageSource,
+            "video_folder": VideoFolderSource,
+        }
+        if source_type not in registry:
+            raise ValueError(f"unknown source type {source_type!r}; "
+                             f"known: {sorted(registry)}")
+        return registry[source_type](**kwargs)
+
+
+class DataAugmenter(ABC):
+    """Factory for per-sample map/filter callables."""
+
+    @abstractmethod
+    def create_transform(self, **kwargs) -> Callable[[Any], Any]:
+        """Return map(record) -> {"image"/..., "text"/...} sample dict."""
+        ...
+
+    def create_filter(self, **kwargs) -> Optional[Callable[[Any], bool]]:
+        """Optional filter(record) -> keep?; None = keep everything."""
+        return None
+
+
+@dataclass
+class MediaDataset:
+    """source + augmenter + media metadata (reference base.py:103-141)."""
+
+    source: DataSource
+    augmenter: DataAugmenter
+    media_type: str = "image"
+
+    def get_source(self, path_override: Optional[str] = None):
+        return self.source.get_source(path_override)
+
+    def get_augmenter(self, **kwargs) -> Callable[[Any], Any]:
+        return self.augmenter.create_transform(**kwargs)

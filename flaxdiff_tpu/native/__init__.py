@@ -1,0 +1,60 @@
+"""Native (C++) components, bound via ctypes.
+
+Build-on-first-use: the shared library is compiled with g++ into this
+package directory and cached; `load_packed_reader()` returns the bound
+ctypes library or raises with the compiler error.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "packed_reader.cpp")
+_LIB = os.path.join(_HERE, "_packed_reader.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build() -> str:
+    # Compile to a process-unique temp path and rename atomically: several
+    # processes (e.g. grain workers) may race the first build, and a
+    # half-written .so must never be dlopen-able.
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build failed: {' '.join(cmd)}\n{proc.stderr}")
+    os.replace(tmp, _LIB)
+    return _LIB
+
+
+def load_packed_reader() -> ctypes.CDLL:
+    """Compile (if stale) and bind the packed-record reader library."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            _build()
+        lib = ctypes.CDLL(_LIB)
+        lib.pr_open.restype = ctypes.c_void_p
+        lib.pr_open.argtypes = [ctypes.c_char_p]
+        lib.pr_num_records.restype = ctypes.c_uint64
+        lib.pr_num_records.argtypes = [ctypes.c_void_p]
+        lib.pr_record_length.restype = ctypes.c_uint64
+        lib.pr_record_length.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.pr_record_ptr.restype = ctypes.c_void_p
+        lib.pr_record_ptr.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.pr_read_record.restype = ctypes.c_uint64
+        lib.pr_read_record.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                       ctypes.c_void_p, ctypes.c_uint64]
+        lib.pr_close.restype = None
+        lib.pr_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib

@@ -1,0 +1,140 @@
+// Packed-record file reader: mmap-backed, zero-copy random access.
+//
+// First-party native replacement for the role grain's C++ ArrayRecord
+// reader plays in the reference (data/sources/images.py:242
+// pygrain.ArrayRecordDataSource): the data layer's hot read path stays
+// out of the Python interpreter. Exposed to Python via ctypes
+// (flaxdiff_tpu/native/__init__.py).
+//
+// File layout (little-endian):
+//   [0:4)   magic "FDTR"
+//   [4:8)   u32 version (1)
+//   [8:16)  u64 num_records
+//   [16:16+16*n) index: n * (u64 offset, u64 length), offsets relative
+//                 to payload start (16 + 16*n)
+//   [...]   payload bytes
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[4] = {'F', 'D', 'T', 'R'};
+
+struct IndexEntry {
+  uint64_t offset;
+  uint64_t length;
+};
+
+struct Reader {
+  int fd = -1;
+  const uint8_t* map = nullptr;
+  size_t map_size = 0;
+  uint64_t num_records = 0;
+  const IndexEntry* index = nullptr;
+  const uint8_t* payload = nullptr;
+  size_t payload_size = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle or nullptr on failure.
+void* pr_open(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (::fstat(fd, &st) != 0 || st.st_size < 16) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* map = ::mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (map == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  const uint8_t* base = static_cast<const uint8_t*>(map);
+  if (std::memcmp(base, kMagic, 4) != 0) {
+    ::munmap(map, st.st_size);
+    ::close(fd);
+    return nullptr;
+  }
+  uint32_t version;
+  std::memcpy(&version, base + 4, 4);
+  if (version != 1) {
+    ::munmap(map, st.st_size);
+    ::close(fd);
+    return nullptr;
+  }
+  uint64_t n;
+  std::memcpy(&n, base + 8, 8);
+  const size_t header = 16 + 16 * static_cast<size_t>(n);
+  if (static_cast<size_t>(st.st_size) < header) {
+    ::munmap(map, st.st_size);
+    ::close(fd);
+    return nullptr;
+  }
+  Reader* r = new Reader;
+  r->fd = fd;
+  r->map = base;
+  r->map_size = st.st_size;
+  r->num_records = n;
+  r->index = reinterpret_cast<const IndexEntry*>(base + 16);
+  r->payload = base + header;
+  r->payload_size = st.st_size - header;
+  // Validate the index once at open so per-record reads skip bounds work.
+  for (uint64_t i = 0; i < n; ++i) {
+    const IndexEntry& e = r->index[i];
+    if (e.offset > r->payload_size || e.length > r->payload_size - e.offset) {
+      delete r;
+      ::munmap(map, st.st_size);
+      ::close(fd);
+      return nullptr;
+    }
+  }
+  return r;
+}
+
+uint64_t pr_num_records(void* handle) {
+  return handle ? static_cast<Reader*>(handle)->num_records : 0;
+}
+
+uint64_t pr_record_length(void* handle, uint64_t idx) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (!r || idx >= r->num_records) return 0;
+  return r->index[idx].length;
+}
+
+// Zero-copy pointer into the mapping (valid until pr_close).
+const void* pr_record_ptr(void* handle, uint64_t idx) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (!r || idx >= r->num_records) return nullptr;
+  return r->payload + r->index[idx].offset;
+}
+
+// Copying read for callers that want an owned buffer. Returns bytes
+// written, or 0 on error / insufficient buffer.
+uint64_t pr_read_record(void* handle, uint64_t idx, void* buf,
+                        uint64_t buf_len) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (!r || idx >= r->num_records) return 0;
+  const IndexEntry& e = r->index[idx];
+  if (buf_len < e.length) return 0;
+  std::memcpy(buf, r->payload + e.offset, e.length);
+  return e.length;
+}
+
+void pr_close(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (!r) return;
+  if (r->map) ::munmap(const_cast<uint8_t*>(r->map), r->map_size);
+  if (r->fd >= 0) ::close(r->fd);
+  delete r;
+}
+
+}  // extern "C"

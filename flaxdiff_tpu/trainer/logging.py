@@ -8,6 +8,7 @@ gated on import.
 from __future__ import annotations
 
 import json
+import numbers
 import os
 import time
 from typing import Any, Dict, Optional, Sequence
@@ -25,9 +26,14 @@ class JsonlLogger:
     def log(self, data: Dict[str, Any], step: Optional[int] = None):
         rec = {"_time": time.time()}
         if step is not None:
-            rec["step"] = step
-        rec.update({k: v for k, v in data.items()
-                    if isinstance(v, (int, float, str, bool, type(None)))})
+            rec["step"] = int(step)
+        for k, v in data.items():
+            if isinstance(v, (str, bool, type(None))):
+                rec[k] = v
+            elif isinstance(v, numbers.Integral):
+                rec[k] = int(v)          # covers np.int32/int64
+            elif isinstance(v, numbers.Real):
+                rec[k] = float(v)        # covers np.float32/float64
         self._fh.write(json.dumps(rec) + "\n")
 
     def log_images(self, key: str, images, step: Optional[int] = None):

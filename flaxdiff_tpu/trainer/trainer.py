@@ -135,18 +135,46 @@ class DiffusionTrainer:
 
     # -- data movement -------------------------------------------------------
     def put_batch(self, batch: PyTree) -> PyTree:
-        """Host-local numpy batch -> global sharded jax arrays."""
+        """Host-local numpy batch -> global sharded jax arrays.
+
+        Non-numeric entries (e.g. raw caption strings kept for validation
+        logging) are dropped here: the jitted step's contract only covers
+        "sample" and the numeric "cond" tree (train_step.py:57)."""
         def put(x):
             x = np.asarray(x)
             spec_axes = (self._batch_axis[0] if len(self._batch_axis) else None)
             spec = P(*((spec_axes,) + (None,) * (x.ndim - 1)))
             return jax.make_array_from_process_local_data(
                 NamedSharding(self.mesh, spec), x)
-        return jax.tree_util.tree_map(put, batch)
+        return jax.tree_util.tree_map(put, self._numeric_subtree(batch))
 
     # -- core loop -----------------------------------------------------------
+    @staticmethod
+    def _numeric_subtree(batch: PyTree) -> PyTree:
+        """Keep only the leaves the jitted step consumes — numpy string
+        arrays (raw captions) cannot be traced."""
+        def keep(x):
+            if isinstance(x, (str, bytes)):
+                return False
+            if isinstance(x, (list, tuple)):
+                return not any(isinstance(e, (str, bytes)) for e in x)
+            return not (isinstance(x, np.ndarray)
+                        and x.dtype.kind in ("U", "S", "O"))
+        if isinstance(batch, dict):
+            out = {}
+            for k, v in batch.items():
+                if isinstance(v, dict):
+                    sub = DiffusionTrainer._numeric_subtree(v)
+                    if sub:
+                        out[k] = sub
+                elif keep(v):
+                    out[k] = v
+            return out
+        return batch
+
     def train_step(self, batch: PyTree):
-        self.state, loss = self._step(self.state, batch)
+        self.state, loss = self._step(self.state,
+                                      self._numeric_subtree(batch))
         return loss
 
     def fit(self,

@@ -1,0 +1,148 @@
+"""Tests for the data pipeline: sources, grain loader, online loader."""
+import numpy as np
+import pytest
+
+from flaxdiff_tpu.data import (
+    DATASET_REGISTRY,
+    ImageAugmenter,
+    MemoryImageSource,
+    OnlineStreamingDataLoader,
+    VideoClipAugmenter,
+    get_dataset_grain,
+    make_batch_iterator,
+)
+from flaxdiff_tpu.data.dataloaders import collate, fallback_batch
+from flaxdiff_tpu.data.dataset_map import get_dataset
+
+
+@pytest.fixture(scope="module")
+def toy_images():
+    rng = np.random.default_rng(0)
+    return (rng.uniform(0, 255, size=(32, 24, 24, 3))).astype(np.uint8)
+
+
+def test_memory_source(toy_images):
+    src = MemoryImageSource(images=toy_images,
+                            labels=[f"img {i}" for i in range(32)])
+    s = src.get_source()
+    assert len(s) == 32
+    rec = s[3]
+    np.testing.assert_array_equal(rec["image"], toy_images[3])
+    assert rec["text"] == "img 3"
+
+
+def test_image_augmenter_resize_and_flip(toy_images):
+    aug = ImageAugmenter(image_size=16, horizontal_flip=False)
+    t = aug.create_transform()
+    out = t({"image": toy_images[0], "text": "hello"})
+    assert out["image"].shape == (16, 16, 3)
+    assert out["text"] == "hello"
+
+
+def test_image_augmenter_tokenizer(toy_images):
+    from flaxdiff_tpu.inputs import HashTextEncoder
+    enc = HashTextEncoder.create(vocab_size=128, features=8, max_length=4)
+    aug = ImageAugmenter(image_size=8, tokenizer=enc.tokenize)
+    out = aug.create_transform()({"image": toy_images[0], "text": "a flower"})
+    assert out["text"]["input_ids"].shape == (4,)
+    assert out["text"]["attention_mask"].sum() == 2
+
+
+def test_collate_and_fallback(toy_images):
+    samples = [{"image": toy_images[i], "text": f"t{i}"} for i in range(4)]
+    batch = collate(samples)
+    assert batch["image"].shape == (4, 24, 24, 3)
+    assert batch["text"] == ["t0", "t1", "t2", "t3"]
+    fb = fallback_batch(batch)
+    assert fb["image"].shape == batch["image"].shape
+    assert np.all(fb["image"] == 0)
+    assert fb["text"] == ["", "", "", ""]
+
+
+def test_grain_pipeline_end_to_end(toy_images):
+    ds = get_dataset("synthetic", n=64, image_size=16)
+    loaded = get_dataset_grain(ds, batch_size=8, image_size=16, seed=0)
+    assert loaded["local_batch_size"] == 8
+    it = loaded["train"](seed=0)
+    batch = next(it)
+    # trainer contract: media under "sample" (train_step.py reads it)
+    assert batch["sample"].shape == (8, 16, 16, 3)
+    assert len(batch["text"]) == 8
+    # epochs continue seamlessly (64/8 = 8 batches/epoch; draw 20)
+    for _ in range(19):
+        batch = next(it)
+    assert batch["sample"].shape == (8, 16, 16, 3)
+
+
+def test_grain_shuffles_between_epochs(toy_images):
+    ds = get_dataset("synthetic", n=16, image_size=8)
+    loaded = get_dataset_grain(ds, batch_size=16, image_size=8)
+    it = loaded["train"](seed=0)
+    e1 = next(it)["sample"]
+    e2 = next(it)["sample"]  # next epoch (all 16 in one batch)
+    assert not np.array_equal(e1, e2)
+    # but same content as multisets (augmentation may flip -> compare sums)
+    assert e1.shape == e2.shape
+
+
+def test_video_clip_augmenter():
+    rng = np.random.default_rng(0)
+    video = rng.uniform(0, 255, size=(12, 20, 20, 3)).astype(np.uint8)
+    aug = VideoClipAugmenter(num_frames=4, image_size=8)
+    out = aug.create_transform()({"video": video, "text": "clip"})
+    assert out["video"].shape == (4, 8, 8, 3)
+    # short video loops
+    out2 = aug.create_transform()({"video": video[:2]})
+    assert out2["video"].shape == (4, 8, 8, 3)
+
+
+def test_online_loader_with_injected_fetcher(toy_images):
+    import cv2
+    # records carry raw encoded bytes via a fake "url" -> bytes fetcher
+    blobs = {}
+    records = []
+    for i in range(8):
+        ok, enc = cv2.imencode(".png",
+                               cv2.cvtColor(toy_images[i], cv2.COLOR_RGB2BGR))
+        assert ok
+        blobs[f"mem://{i}"] = enc.tobytes()
+        records.append({"url": f"mem://{i}", "text": f"cap {i}"})
+
+    loader = OnlineStreamingDataLoader(
+        records, batch_size=4, image_size=16, num_threads=2,
+        fetcher=lambda url: blobs[url], process_index=0, process_count=1,
+        timeout=10.0)
+    it = iter(loader)
+    batch = next(it)
+    assert batch["image"].shape == (4, 16, 16, 3)
+    assert len(batch["text"]) == 4
+    loader.stop()
+
+
+def test_online_loader_skips_bad_records(toy_images):
+    import cv2
+    ok, enc = cv2.imencode(".png", toy_images[0])
+    blobs = {"mem://good": enc.tobytes(), "mem://bad": b"not an image"}
+    records = [{"url": "mem://good"}, {"url": "mem://bad"}]
+    loader = OnlineStreamingDataLoader(
+        records, batch_size=2, image_size=8, num_threads=2,
+        fetcher=lambda url: blobs[url], process_index=0, process_count=1,
+        timeout=10.0)
+    batch = next(iter(loader))
+    assert batch["image"].shape == (2, 8, 8, 3)
+    loader.stop()
+
+
+def test_registry():
+    assert "synthetic" in DATASET_REGISTRY
+    assert "oxford_flowers102" in DATASET_REGISTRY
+    with pytest.raises(ValueError):
+        get_dataset("nope")
+
+
+def test_make_batch_iterator(toy_images):
+    it = make_batch_iterator(toy_images, batch_size=4,
+                             labels=[str(i) for i in range(32)])
+    b = next(it)
+    assert b["sample"].shape == (4, 24, 24, 3)
+    assert len(b["text"]) == 4
