@@ -1,0 +1,16 @@
+"""Inference: config-driven model rebuild + cached samplers.
+
+Capability parity with reference flaxdiff/inference/ (pipeline.py:42-272,
+utils.py:61-349) without the wandb dependency in the core path: configs
+are plain dicts (what serialize() methods emit) and checkpoints load
+through the framework's own Checkpointer.
+"""
+from .pipeline import DiffusionInferencePipeline
+from .registry import MODEL_REGISTRY, build_model, parse_architecture_name
+
+__all__ = [
+    "DiffusionInferencePipeline",
+    "MODEL_REGISTRY",
+    "build_model",
+    "parse_architecture_name",
+]

@@ -1,0 +1,155 @@
+"""DiffusionInferencePipeline: rebuild model from a config dict, restore a
+checkpoint, generate with cached samplers.
+
+Reference inference/pipeline.py:42-272. The wandb run-config store is
+replaced by a plain serialized config dict (saved next to checkpoints by
+the CLI); wandb-based construction can layer on top by fetching that dict.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..inputs import DiffusionInputConfig
+from ..predictors import TRANSFORM_REGISTRY, PredictionTransform
+from ..samplers import SAMPLER_REGISTRY, DiffusionSampler, Sampler
+from ..schedulers import SCHEDULE_REGISTRY, get_schedule
+from ..utils import RngSeq
+from .registry import build_model
+
+CONFIG_FILENAME = "pipeline_config.json"
+
+
+class DiffusionInferencePipeline:
+    """Holds model + params + diffusion math; caches one DiffusionSampler
+    per (sampler class, guidance scale) pair (reference
+    pipeline.py:176-215)."""
+
+    def __init__(self, model, params: Dict[str, Any],
+                 schedule, transform: PredictionTransform,
+                 input_config: Optional[DiffusionInputConfig] = None,
+                 autoencoder=None,
+                 ema_params: Optional[Dict[str, Any]] = None,
+                 config: Optional[Dict[str, Any]] = None):
+        self.model = model
+        self.params = params
+        self.ema_params = ema_params
+        self.schedule = schedule
+        self.transform = transform
+        self.input_config = input_config
+        self.autoencoder = autoencoder
+        self.config = config or {}
+        self._sampler_cache: Dict[Tuple[type, float], DiffusionSampler] = {}
+
+    # -- construction --------------------------------------------------------
+    @staticmethod
+    def from_config(config: Dict[str, Any], params: Dict[str, Any],
+                    ema_params: Optional[Dict[str, Any]] = None,
+                    autoencoder=None) -> "DiffusionInferencePipeline":
+        """config = {"model": {"name": ..., **kwargs}, "schedule":
+        {"name": ..., **kwargs}, "predictor": name, "input_config": ...}."""
+        model_cfg = dict(config["model"])
+        model = build_model(model_cfg.pop("name"), **model_cfg)
+        sched_cfg = dict(config.get("schedule", {"name": "cosine"}))
+        schedule = get_schedule(sched_cfg.pop("name"), **sched_cfg)
+        pred_name = config.get("predictor", "epsilon")
+        if pred_name not in TRANSFORM_REGISTRY:
+            raise ValueError(f"unknown predictor {pred_name!r}")
+        transform = TRANSFORM_REGISTRY[pred_name]()
+        input_config = None
+        if config.get("input_config"):
+            input_config = DiffusionInputConfig.deserialize(
+                config["input_config"])
+        return DiffusionInferencePipeline(
+            model=model, params=params, ema_params=ema_params,
+            schedule=schedule, transform=transform,
+            input_config=input_config, autoencoder=autoencoder,
+            config=config)
+
+    @staticmethod
+    def from_checkpoint(checkpoint_dir: str,
+                        step: Optional[int] = None,
+                        autoencoder=None) -> "DiffusionInferencePipeline":
+        """Load the config dict + state saved by the training CLI."""
+        cfg_path = os.path.join(checkpoint_dir, CONFIG_FILENAME)
+        with open(cfg_path) as f:
+            config = json.load(f)
+
+        import orbax.checkpoint as ocp
+        from ..trainer.checkpoints import Checkpointer
+        ckpt = Checkpointer(checkpoint_dir)
+        step = ckpt.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {checkpoint_dir}")
+        restored = ckpt._mgr.restore(step)   # structure-free restore
+        state = restored["state"]
+        params = state["params"]
+        ema = state.get("ema_params")
+        ckpt.close()
+        return DiffusionInferencePipeline.from_config(
+            config, params=params, ema_params=ema, autoencoder=autoencoder)
+
+    # -- sampling ------------------------------------------------------------
+    def get_sampler(self, sampler: str | Sampler | Type[Sampler] = "ddim",
+                    guidance_scale: float = 0.0) -> DiffusionSampler:
+        if isinstance(sampler, str):
+            if sampler not in SAMPLER_REGISTRY:
+                raise ValueError(f"unknown sampler {sampler!r}")
+            sampler_obj = SAMPLER_REGISTRY[sampler]()
+        elif isinstance(sampler, type):
+            sampler_obj = sampler()
+        else:
+            sampler_obj = sampler
+        key = (type(sampler_obj), float(guidance_scale))
+        if key not in self._sampler_cache:
+            self._sampler_cache[key] = DiffusionSampler(
+                model_fn=lambda p, x, t, c: self.model.apply(p, x, t, c),
+                schedule=self.schedule, transform=self.transform,
+                autoencoder=self.autoencoder,
+                guidance_scale=guidance_scale,
+                sampler=sampler_obj)
+        return self._sampler_cache[key]
+
+    def generate_samples(self,
+                         num_samples: int = 4,
+                         resolution: int = 64,
+                         diffusion_steps: int = 50,
+                         sampler: str | Sampler = "euler_ancestral",
+                         guidance_scale: float = 0.0,
+                         prompts=None,
+                         use_ema: bool = True,
+                         seed: int = 42,
+                         sequence_length: Optional[int] = None,
+                         channels: int = 3) -> np.ndarray:
+        """Generate images/videos; prompts are encoded through the input
+        config when given (reference pipeline.py:217-272)."""
+        params = (self.ema_params
+                  if use_ema and self.ema_params is not None else self.params)
+        conditioning = unconditional = None
+        if prompts is not None:
+            if self.input_config is None or not self.input_config.conditions:
+                raise ValueError("pipeline has no conditioning inputs")
+            cond = self.input_config.conditions[0]
+            conditioning = jnp.asarray(cond.encoder(list(prompts)))
+            num_samples = conditioning.shape[0]
+            unconditional = self.input_config.get_unconditionals(
+                batch_size=num_samples)[0]
+        ds = self.get_sampler(sampler, guidance_scale)
+        out = ds.generate_samples(
+            params=params, num_samples=num_samples, resolution=resolution,
+            diffusion_steps=diffusion_steps, rngstate=RngSeq.create(seed),
+            sequence_length=sequence_length, channels=channels,
+            conditioning=conditioning, unconditional=unconditional)
+        return np.asarray(jax.device_get(out))
+
+
+def save_pipeline_config(checkpoint_dir: str, config: Dict[str, Any]):
+    """Write the config dict the pipeline rebuilds from."""
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    with open(os.path.join(checkpoint_dir, CONFIG_FILENAME), "w") as f:
+        json.dump(config, f, indent=2)

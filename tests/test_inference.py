@@ -1,0 +1,98 @@
+"""Tests: model registry, architecture suffixes, inference pipeline, CLI."""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flaxdiff_tpu.inference import (
+    DiffusionInferencePipeline,
+    build_model,
+    parse_architecture_name,
+)
+from flaxdiff_tpu.models.dit import SimpleDiT
+from flaxdiff_tpu.models.unet import Unet
+
+
+def test_parse_architecture_name():
+    assert parse_architecture_name("unet") == ("unet", {})
+    base, flags = parse_architecture_name("simple_dit+hilbert")
+    assert base == "simple_dit" and flags == {"use_hilbert": True}
+    base, flags = parse_architecture_name("hybrid_ssm+zigzag+2d")
+    assert flags == {"use_zigzag": True, "use_2d_fusion": True}
+    with pytest.raises(ValueError):
+        parse_architecture_name("unet+bogus")
+
+
+def test_build_model_resolves_strings():
+    m = build_model("simple_dit", emb_features=32, num_heads=4,
+                    num_layers=1, patch_size=4, dtype="bf16",
+                    activation="gelu")
+    assert isinstance(m, SimpleDiT)
+    assert m.dtype == jnp.bfloat16
+
+
+def test_build_model_drops_unknown_kwargs():
+    with pytest.warns(UserWarning):
+        m = build_model("unet", emb_features=32, bogus_flag=True)
+    assert isinstance(m, Unet)
+
+
+def test_pipeline_from_config_and_sampler_cache(rng):
+    config = {
+        "model": {"name": "simple_dit", "emb_features": 32, "num_heads": 4,
+                  "num_layers": 1, "patch_size": 4, "output_channels": 1},
+        "schedule": {"name": "cosine", "timesteps": 100},
+        "predictor": "epsilon",
+    }
+    model = build_model("simple_dit", emb_features=32, num_heads=4,
+                        num_layers=1, patch_size=4, output_channels=1)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 1)),
+                        jnp.zeros((1,)), None)
+    pipe = DiffusionInferencePipeline.from_config(config, params=params)
+
+    s1 = pipe.get_sampler("ddim", guidance_scale=0.0)
+    s2 = pipe.get_sampler("ddim", guidance_scale=0.0)
+    s3 = pipe.get_sampler("ddim", guidance_scale=2.0)
+    s4 = pipe.get_sampler("euler", guidance_scale=0.0)
+    assert s1 is s2 and s1 is not s3 and s1 is not s4
+
+    out = pipe.generate_samples(num_samples=2, resolution=8,
+                                diffusion_steps=4, sampler="ddim",
+                                channels=1, use_ema=False)
+    assert out.shape == (2, 8, 8, 1)
+    assert np.all(np.isfinite(out))
+
+
+def test_cli_end_to_end(tmp_path):
+    """The CLI trains on the synthetic dataset and the inference pipeline
+    reloads from its checkpoint dir."""
+    from train import main
+    ckpt_dir = str(tmp_path / "run")
+    hist = main([
+        "--dataset", "synthetic", "--image_size", "8",
+        "--batch_size", "16", "--architecture", "unet",
+        "--model_config", json.dumps({
+            "emb_features": 16, "feature_depths": [8, 12],
+            "num_res_blocks": 1, "norm_groups": 4,
+            "attention_configs": [None, None]}),
+        "--dtype", "fp32",
+        "--total_steps", "6", "--warmup_steps", "2",
+        "--save_every", "3", "--log_every", "3",
+        "--text_encoder", "hash",
+        "--checkpoint_dir", ckpt_dir,
+        "--mesh_data", "2", "--mesh_fsdp", "4",
+    ])
+    assert np.isfinite(hist["final_loss"])
+    log = (tmp_path / "run" / "train_log.jsonl").read_text().strip()
+    assert "loss" in log
+
+    pipe = DiffusionInferencePipeline.from_checkpoint(ckpt_dir)
+    out = pipe.generate_samples(num_samples=2, resolution=8,
+                                diffusion_steps=3, sampler="ddim",
+                                guidance_scale=1.5,
+                                prompts=["a photo", "another"],
+                                use_ema=True)
+    assert out.shape == (2, 8, 8, 3)
+    assert np.all(np.isfinite(out))
