@@ -1,16 +1,28 @@
-"""First-party Pallas TPU flash attention (online-softmax, O(N) memory).
+"""First-party Pallas TPU flash attention: forward AND backward kernels.
 
 Replaces the reference's dependency on JAX's prebuilt kernel
-(reference flaxdiff/models/attention.py:14-17,100-102). Design:
+(reference flaxdiff/models/attention.py:14-17,100-102) with a fully
+first-party implementation covering the whole autodiff path. Design:
 
-- grid = (batch*heads, q_blocks); each program holds one q block in VMEM
-  and streams k/v blocks with a fori_loop carrying running (max, sum, acc)
-  in f32 — the classic online softmax, never materializing [Lq, Lk] in HBM.
-- kv length is masked via iota so cross-attention (e.g. CLIP kv_len=77)
-  works after padding to the lane-aligned block.
-- backward: custom_vjp recomputes attention with the XLA path and reuses
-  its VJP — correct gradients, flash-memory forward. A dedicated backward
-  kernel is a later optimization.
+- Forward: grid = (batch*heads, q_blocks, kv_blocks). Each program holds
+  one q block in VMEM; the kv grid dimension streams k/v blocks from HBM
+  through the Pallas pipeline (no whole-KV residency — VMEM use is
+  O(block_q·d + block_k·d) regardless of sequence length). Running
+  (max, sum, acc) live in VMEM scratch persisted across the innermost
+  (sequential) grid dimension — classic online softmax, [Lq, Lk] is never
+  materialized in HBM. The forward also emits per-row logsumexp,
+  lane-replicated as [B*H, Lq, 128] f32 (the layout the TPU vector unit
+  wants; same convention as JAX's prebuilt kernel residuals).
+- Backward: two kernels. dq: grid (batch*heads, q_blocks, kv_blocks)
+  accumulating dq over the kv dimension. dk/dv: grid (batch*heads,
+  kv_blocks, q_blocks) accumulating over the q dimension. Both recompute
+  probabilities blockwise from (q, k, lse) — O(N) memory, no stored probs.
+  The per-row correction term delta = rowsum(dO * O) is computed in-kernel
+  from the (full-head-dim) dO/O blocks, so no extra residual is stored.
+- kv-length masking via lane iota, so cross-attention (e.g. CLIP kv_len=77)
+  works after padding to the lane-aligned block. Padded q rows are exact:
+  zero-padded q gives finite lse, zero-padded dO zeroes their gradient
+  contributions (no inf·0 NaNs).
 """
 from __future__ import annotations
 
@@ -20,40 +32,163 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+LANES = 128
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, block_k: int,
-                  kv_len: int):
-    q = q_ref[0].astype(jnp.float32)  # [block_q, d]
-    block_q, d = q.shape
-    padded_kv = k_ref.shape[1]
-    num_kb = padded_kv // block_k
+def _bcast(x: jax.Array, width: int) -> jax.Array:
+    """Widen a lane-replicated [rows, w] value to [rows, width]."""
+    w = x.shape[1]
+    if w == width:
+        return x
+    if w == 1:
+        return jnp.broadcast_to(x, (x.shape[0], width))
+    return pltpu.repeat(x, width // w, axis=1)
 
-    def body(i, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [block_q, block_k]
-        kv_idx = i * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(kv_idx < kv_len, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
 
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
 
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
+                scale: float, kv_len: int, block_k: int):
+    # rest = (lse_ref?, m_scr, l_scr, acc_scr); lse is only emitted on the
+    # custom_vjp fwd path — the plain primal skips the residual write.
+    if len(rest) == 4:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        lse_ref, (m_scr, l_scr, acc_scr) = None, rest
+    ki = pl.program_id(2)
+    num_kb = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0]                                # [block_q, d] native dtype
+    k = k_ref[0]                                # [block_k, d]
+    v = v_ref[0]
+    d = q.shape[-1]
+
+    # bf16 x bf16 -> f32 rides the MXU natively; only the softmax math is f32.
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    kv_idx = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(kv_idx < kv_len, s, NEG_INF)
+
+    m_prev = m_scr[...]                          # [block_q, LANES]
+    l_prev = l_scr[...]
+    m_curr = jnp.max(s, axis=1, keepdims=True)   # [block_q, 1]
+    m_next = jnp.maximum(m_prev, m_curr)         # lane-replicated
+    p = jnp.exp(s - _bcast(m_next, block_k))
+    alpha = jnp.exp(m_prev - m_next)             # [block_q, LANES]
+    l_scr[...] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    m_scr[...] = m_next
+    acc_scr[...] = (acc_scr[...] * _bcast(alpha, d)
+                    + jax.lax.dot_general(
+                        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32))
+
+    @pl.when(ki == num_kb - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0] = (acc_scr[...] * _bcast(1.0 / l, d)
+                    ).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse_ref[0] = m_scr[...] + jnp.log(l)
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref, dq_ref, dq_scr,
+                   *, scale: float, kv_len: int, block_k: int):
+    ki = pl.program_id(2)
+    num_kb = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    q = q_ref[0]                                 # [block_q, d] native dtype
+    k = k_ref[0]                                 # [block_k, d]
+    v = v_ref[0]
+    g = g_ref[0]                                 # [block_q, d]
+    o = o_ref[0]
+    lse = lse_ref[0]                             # [block_q, LANES] f32
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    kv_idx = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(kv_idx < kv_len, s, NEG_INF)
+    p = jnp.exp(s - _bcast(lse, block_k))
+
+    dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=1, keepdims=True)             # [block_q, 1]
+    ds = p * (dp - delta) * scale
+    dq_scr[...] += jax.lax.dot_general(ds.astype(k.dtype), k,
+                                       (((1,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_kb - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale: float, kv_len: int, block_k: int):
+    qi = pl.program_id(2)
+    num_qb = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    ki = pl.program_id(1)
+    q = q_ref[0]                                 # [block_q, d] native dtype
+    k = k_ref[0]                                 # [block_k, d]
+    v = v_ref[0]
+    g = g_ref[0]                                 # [block_q, d]
+    o = o_ref[0]
+    lse = lse_ref[0]                             # [block_q, LANES]
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    kv_idx = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(kv_idx < kv_len, s, NEG_INF)
+    p = jnp.exp(s - _bcast(lse, block_k))
+
+    # dv += p^T @ g  (contract the q dimension)
+    dv_scr[...] += jax.lax.dot_general(p.astype(g.dtype), g,
+                                       (((0,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=1, keepdims=True)
+    ds = p * (dp - delta) * scale
+    dk_scr[...] += jax.lax.dot_general(ds.astype(q.dtype), q,
+                                       (((0,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_qb - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Host-side wrappers
+# ---------------------------------------------------------------------------
 
 def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
     size = x.shape[axis]
@@ -65,61 +200,174 @@ def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
     return jnp.pad(x, widths)
 
 
-def _flash_fwd_impl(q: jax.Array, k: jax.Array, v: jax.Array,
-                    scale: Optional[float], block_q: int = 128,
-                    block_k: int = 128, interpret: bool = False) -> jax.Array:
-    """q,k,v: [B, L, H, D] -> [B, Lq, H, D]."""
+def _to_bh(x: jax.Array) -> jax.Array:
+    """[B, L, H, D] -> [B*H, L, D]."""
+    b, l, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+
+
+def _from_bh(x: jax.Array, b: int, h: int) -> jax.Array:
+    bh, l, d = x.shape
+    return x.reshape(b, h, l, d).transpose(0, 2, 1, 3)
+
+
+def _block_sizes(lq: int, lk: int, block_q: int, block_k: int,
+                 interpret: bool):
+    """Effective block sizes. On TPU blocks stay lane-aligned (the caller
+    pads head_dim; seq dims are padded here); in interpret mode small
+    test shapes shrink the blocks instead."""
+    if interpret:
+        bq = min(block_q, max(lq, 8))
+        bk = min(block_k, max(lk, 8))
+    else:
+        bq, bk = block_q, block_k
+    return bq, bk
+
+
+def _fwd_impl(q, k, v, scale, block_q, block_k, interpret,
+              save_residuals: bool = False):
     b, lq, h, d = q.shape
     kv_len = k.shape[1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
+    bq, bk = _block_sizes(lq, kv_len, block_q, block_k, interpret)
+    lanes = 1 if interpret else LANES
 
-    # [B, L, H, D] -> [B*H, L, D]
-    def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-
-    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
-    block_q_eff = min(block_q, max(lq, 8))
-    qb = _pad_to(qb, 1, block_q_eff)
-    block_k_eff = min(block_k, max(kv_len, 8))
-    kb = _pad_to(kb, 1, block_k_eff)
-    vb = _pad_to(vb, 1, block_k_eff)
+    qb = _pad_to(_to_bh(q), 1, bq)
+    kb = _pad_to(_to_bh(k), 1, bk)
+    vb = _pad_to(_to_bh(v), 1, bk)
     lq_pad, lk_pad = qb.shape[1], kb.shape[1]
 
-    out = pl.pallas_call(
-        functools.partial(_flash_kernel, scale=scale, block_k=block_k_eff,
-                          kv_len=kv_len),
-        grid=(b * h, lq_pad // block_q_eff),
+    grid = (b * h, lq_pad // bq, lk_pad // bk)
+    out_specs = [pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0))]
+    out_shape = [jax.ShapeDtypeStruct((b * h, lq_pad, d), q.dtype)]
+    if save_residuals:
+        out_specs.append(
+            pl.BlockSpec((1, bq, lanes), lambda bh, qi, ki: (bh, qi, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, lq_pad, lanes), jnp.float32))
+    res = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, kv_len=kv_len,
+                          block_k=bk),
+        grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q_eff, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, lk_pad, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, lk_pad, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q_eff, d), lambda bh, qi: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, lq_pad, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((bq, lanes), jnp.float32),   # running max
+            pltpu.VMEM((bq, lanes), jnp.float32),   # running sum
+            pltpu.VMEM((bq, d), jnp.float32),       # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qb, kb, vb)
+    return (res[0], res[1]) if save_residuals else (res[0], None)
 
-    out = out[:, :lq, :].reshape(b, h, lq, d).transpose(0, 2, 1, 3)
-    return out
+
+
+def _bwd_impl(q, k, v, out_bh, lse, g, scale, block_q, block_k, interpret):
+    b, lq, h, d = q.shape
+    kv_len = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    bq, bk = _block_sizes(lq, kv_len, block_q, block_k, interpret)
+
+    qb = _pad_to(_to_bh(q), 1, bq)
+    kb = _pad_to(_to_bh(k), 1, bk)
+    vb = _pad_to(_to_bh(v), 1, bk)
+    gb = _pad_to(_to_bh(g), 1, bq)
+    ob = _pad_to(out_bh, 1, bq)
+    lq_pad, lk_pad = qb.shape[1], kb.shape[1]
+    lanes = lse.shape[-1]
+
+    qkv_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),       # dO
+        pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),       # O
+        pl.BlockSpec((1, bq, lanes), lambda bh, qi, ki: (bh, qi, 0)),   # lse
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, kv_len=kv_len,
+                          block_k=bk),
+        grid=(b * h, lq_pad // bq, lk_pad // bk),
+        in_specs=qkv_specs,
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, lq_pad, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qb, kb, vb, gb, ob, lse)
+
+    # dk/dv: swap the roles of the q and kv grid dimensions.
+    kv_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+        pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),       # dO
+        pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),       # O
+        pl.BlockSpec((1, bq, lanes), lambda bh, ki, qi: (bh, qi, 0)),   # lse
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, kv_len=kv_len,
+                          block_k=bk),
+        grid=(b * h, lk_pad // bk, lq_pad // bq),
+        in_specs=kv_specs,
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, lk_pad, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, lk_pad, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qb, kb, vb, gb, ob, lse)
+
+    dq = _from_bh(dq[:, :lq], b, h)
+    dk = _from_bh(dk[:, :kv_len], b, h)
+    dv = _from_bh(dv[:, :kv_len], b, h)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     scale: Optional[float] = None, block_q: int = 128,
                     block_k: int = 128, interpret: bool = False) -> jax.Array:
-    return _flash_fwd_impl(q, k, v, scale, block_q, block_k, interpret)
+    """Flash attention over [B, L, H, D] tensors (full fwd+bwd in Pallas).
+
+    head_dim must be a multiple of 128 on real TPU (the dispatch layer in
+    ops/attention.py zero-pads it); sequence dims are padded internally.
+    """
+    out, _ = _fwd_impl(q, k, v, scale, block_q, block_k, interpret)
+    b, lq, h, _ = q.shape
+    return _from_bh(out, b, h)[:, :lq]
 
 
 def _fwd(q, k, v, scale, block_q, block_k, interpret):
-    return _flash_fwd_impl(q, k, v, scale, block_q, block_k, interpret), (q, k, v)
+    out, lse = _fwd_impl(q, k, v, scale, block_q, block_k, interpret,
+                         save_residuals=True)
+    b, lq, h, _ = q.shape
+    return _from_bh(out, b, h)[:, :lq], (q, k, v, out, lse)
 
 
 def _bwd(scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    from .attention import _xla_attention
-    _, vjp = jax.vjp(lambda q_, k_, v_: _xla_attention(q_, k_, v_, scale=scale), q, k, v)
-    return vjp(g)
+    q, k, v, out_bh, lse = res
+    return _bwd_impl(q, k, v, out_bh, lse, g, scale, block_q, block_k,
+                     interpret)
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -172,10 +172,14 @@ def _gn_fwd(x, scale, bias, groups, eps, apply_silu, interpret, force_pallas):
 
 
 def _gn_bwd(groups, eps, apply_silu, interpret, force_pallas, res, g):
-    # Backward recomputes through the XLA reference path — correct
-    # gradients with the Pallas kernel on the forward (a dedicated
-    # backward kernel is a later optimization, same policy as
-    # flash_attention._bwd).
+    # Backward recomputes through the XLA reference path. Unlike
+    # attention (whose naive backward materializes an O(N^2) probability
+    # matrix — flash_attention now has dedicated Pallas dq/dk/dv kernels),
+    # GroupNorm's backward is a bandwidth-bound elementwise chain over the
+    # same O(N*C) activations the forward reads: recompute adds no
+    # asymptotic memory, and XLA fuses it into the surrounding backward
+    # elementwise ops. A dedicated kernel would save at most one re-read
+    # of x — not worth the maintenance until profiling says otherwise.
     x, scale, bias = res
     _, vjp = jax.vjp(
         lambda x_, s_, b_: _xla_groupnorm_silu(x_, s_, b_, groups, eps,

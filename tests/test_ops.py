@@ -33,6 +33,46 @@ def test_flash_attention_grad_matches_xla():
     np.testing.assert_allclose(g_flash, g_ref, rtol=1e-3, atol=1e-3)
 
 
+@pytest.mark.parametrize("lq,lk", [(128, 128), (200, 77)])
+def test_flash_attention_all_grads_match_xla(lq, lk):
+    """dq/dk/dv from the Pallas backward kernels vs the XLA VJP, including
+    the cross-attention shape (padded kv with masked tail)."""
+    key = jax.random.PRNGKey(11)
+    b, h, d = 2, 2, 32
+    q = jax.random.normal(key, (b, lq, h, d))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (b, lk, h, d))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (b, lk, h, d))
+    g = jax.random.normal(jax.random.fold_in(key, 3), (b, lq, h, d))
+
+    def loss(fn):
+        return lambda q_, k_, v_: jnp.sum(fn(q_, k_, v_) * g)
+
+    flash = lambda q_, k_, v_: flash_attention(q_, k_, v_, None, 64, 64, True)
+    got = jax.grad(loss(flash), (0, 1, 2))(q, k, v)
+    want = jax.grad(loss(_xla_attention), (0, 1, 2))(q, k, v)
+    for name, a, b_ in zip(("dq", "dk", "dv"), got, want):
+        np.testing.assert_allclose(a, b_, rtol=2e-3, atol=2e-3, err_msg=name)
+
+
+def test_flash_attention_long_sequence_grad():
+    """VERDICT r1 #2 done-criterion: gradients vs XLA at >= 8k tokens in
+    interpret mode (blockwise backward, no [L, L] materialization)."""
+    key = jax.random.PRNGKey(5)
+    b, l, h, d = 1, 8192, 1, 64
+    q = jax.random.normal(key, (b, l, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (b, l, h, d))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (b, l, h, d))
+    g = jax.random.normal(jax.random.fold_in(key, 3), (b, l, h, d))
+
+    flash = lambda q_, k_, v_: flash_attention(q_, k_, v_, None, 1024, 1024,
+                                               True)
+    got = jax.grad(lambda *a: jnp.sum(flash(*a) * g), (0, 1, 2))(q, k, v)
+    want = jax.grad(lambda *a: jnp.sum(_xla_attention(*a) * g),
+                    (0, 1, 2))(q, k, v)
+    for name, a, b_ in zip(("dq", "dk", "dv"), got, want):
+        np.testing.assert_allclose(a, b_, rtol=5e-3, atol=5e-3, err_msg=name)
+
+
 @pytest.mark.parametrize("apply_silu", [True, False])
 def test_fused_groupnorm_silu_matches_xla(apply_silu):
     key = jax.random.PRNGKey(0)
