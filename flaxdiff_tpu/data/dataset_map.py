@@ -10,6 +10,7 @@ from typing import Callable, Dict
 
 import numpy as np
 
+from .sources.av import AudioVideoAugmenter, AVSyncSource
 from .sources.base import MediaDataset
 from .sources.images import HFImageSource, ImageAugmenter, MemoryImageSource
 from .sources.videos import VideoClipAugmenter, VideoFolderSource
@@ -65,3 +66,30 @@ def _video_folder(root: str, image_size: int = 64, num_frames: int = 8,
         augmenter=VideoClipAugmenter(num_frames=num_frames,
                                      image_size=image_size),
         media_type="video")
+
+
+@register_dataset("av_folder")
+def _av_folder(root: str, image_size: int = 64, num_frames: int = 16,
+               audio_frame_padding: int = 3, **kwargs) -> MediaDataset:
+    """Synchronized video+audio clips (reference mediaDatasetMap video
+    entries, dataset_map.py:130-174); audio via ffmpeg or sidecar wav."""
+    return MediaDataset(
+        source=VideoFolderSource(root=root),
+        augmenter=AudioVideoAugmenter(
+            num_frames=num_frames, image_size=image_size,
+            audio_frame_padding=audio_frame_padding),
+        media_type="audiovideo")
+
+
+@register_dataset("voxceleb2_local")
+def _voxceleb2(root: str, image_size: int = 64, num_frames: int = 16,
+               with_mel: bool = True, with_face_mask: bool = True,
+               **kwargs) -> MediaDataset:
+    """Identity-structured AV corpus (reference voxceleb2.py:159-276):
+    face-region masks + mel spectrograms on top of the AV clip path."""
+    return MediaDataset(
+        source=AVSyncSource(root=root),
+        augmenter=AudioVideoAugmenter(
+            num_frames=num_frames, image_size=image_size,
+            with_mel=with_mel, with_face_mask=with_face_mask),
+        media_type="audiovideo")

@@ -1,3 +1,11 @@
 from .base import DataAugmenter, DataSource, MediaDataset
+from .av import (AudioVideoAugmenter, AVSyncSource, extract_audio,
+                 log_mel_spectrogram, read_av_random_clip, simple_face_mask,
+                 video_fps)
 
-__all__ = ["DataSource", "DataAugmenter", "MediaDataset"]
+__all__ = [
+    "DataSource", "DataAugmenter", "MediaDataset",
+    "AudioVideoAugmenter", "AVSyncSource", "extract_audio",
+    "log_mel_spectrogram", "read_av_random_clip", "simple_face_mask",
+    "video_fps",
+]

@@ -9,9 +9,11 @@ not the prefix-splice variant in diffusion_trainer.py:188-190).
 """
 from .encoders import (
     CONDITIONAL_ENCODERS_REGISTRY,
+    AudioEncoder,
     CLIPTextEncoder,
     ConditioningEncoder,
     HashTextEncoder,
+    MelAudioEncoder,
     TextEncoder,
 )
 from .config import ConditionalInputConfig, DiffusionInputConfig
@@ -21,6 +23,8 @@ __all__ = [
     "TextEncoder",
     "CLIPTextEncoder",
     "HashTextEncoder",
+    "AudioEncoder",
+    "MelAudioEncoder",
     "CONDITIONAL_ENCODERS_REGISTRY",
     "ConditionalInputConfig",
     "DiffusionInputConfig",

@@ -176,9 +176,105 @@ class HashTextEncoder(TextEncoder):
             max_length=config["max_length"])
 
 
+class _MelProjModel:
+    """Fixed-seed linear projection of per-frame spectral features into the
+    conditioning embedding space; deterministic, no downloads."""
+
+    class _Out:
+        def __init__(self, h):
+            self.last_hidden_state = h
+
+    def __init__(self, n_mels: int, features: int, seed: int = 0):
+        key = jax.random.PRNGKey(seed)
+        self.proj = jax.random.normal(
+            key, (n_mels, features), jnp.float32) / np.sqrt(n_mels)
+
+    def __call__(self, input_ids, attention_mask=None):
+        # input_ids: [B, N, n_mels] framewise mel features
+        return self._Out(jnp.asarray(input_ids) @ self.proj)
+
+
+@dataclass
+class AudioEncoder(ConditioningEncoder):
+    """Audio conditioning (batch key 'audio'). The reference tokenizes the
+    clip waveform with an HF AutoAudioTokenizer inside its AV augmenter
+    (reference data/sources/videos.py:189-211); this base fixes the batch
+    key and token contract: tokens are per-video-frame feature rows."""
+
+    @property
+    def key(self) -> str:
+        return "audio"
+
+
+@dataclass
+class MelAudioEncoder(AudioEncoder):
+    """Offline deterministic audio encoder: per-video-frame log-mel energy
+    features -> fixed-seed projection. One token per video frame, so the
+    sequence aligns 1:1 with the clip's temporal axis — the natural
+    cross-attention context for the 3D UNet.
+
+    Accepts waveforms shaped [B, T] (raw), [B, N, K] (framewise), or
+    [B, 1, N, 1, K] / [N+2P, K] reference contract shapes."""
+
+    n_mels: int = 32
+    features: int = 64
+    samples_per_frame: int = 640  # 16 kHz / 25 fps
+
+    @staticmethod
+    def create(n_mels: int = 32, features: int = 64,
+               samples_per_frame: int = 640) -> "MelAudioEncoder":
+        return MelAudioEncoder(
+            model=_MelProjModel(n_mels, features),
+            tokenizer=None, n_mels=n_mels, features=features,
+            samples_per_frame=samples_per_frame)
+
+    def tokenize(self, data):
+        from ..data.sources.av import _mel_filterbank
+        x = np.asarray(data, np.float32)
+        if x.ndim == 1:
+            x = x[None]
+        if x.ndim == 2 and x.shape[-1] == self.samples_per_frame:
+            # [N, K] already-framed audio (the reference's
+            # full_padded_audio contract) -> one batch of N tokens
+            x = x[None]
+        elif x.ndim == 2:  # [B, T] raw waveform -> framewise
+            spf = self.samples_per_frame
+            n = x.shape[1] // spf
+            x = x[:, :n * spf].reshape(x.shape[0], n, spf)
+        else:  # squeeze reference [B, 1, N, 1, K] / [B, N, 1, K] shapes
+            x = x.reshape(x.shape[0], -1, x.shape[-1])
+        spf = x.shape[-1]
+        window = np.hanning(spf).astype(np.float32)
+        spec = np.abs(np.fft.rfft(x * window, axis=-1)) ** 2
+        fb = _mel_filterbank(sr=16000, n_fft=spf - (spf % 2),
+                             n_mels=self.n_mels)
+        # filterbank built for n_fft bins; trim/pad spec to match
+        spec = spec[..., :fb.shape[1]]
+        mel = np.log10(np.maximum(spec @ fb.T, 1e-10))
+        mask = np.ones(mel.shape[:2], np.int32)
+        return {"input_ids": mel.astype(np.float32),
+                "attention_mask": mask}
+
+    def encode_from_tokens(self, tokens):
+        return self.model(input_ids=tokens["input_ids"]).last_hidden_state
+
+    def serialize(self) -> Dict[str, Any]:
+        return {"type": "mel_audio", "n_mels": self.n_mels,
+                "features": self.features,
+                "samples_per_frame": self.samples_per_frame}
+
+    @staticmethod
+    def deserialize(config: Dict[str, Any]) -> "MelAudioEncoder":
+        return MelAudioEncoder.create(
+            n_mels=config["n_mels"], features=config["features"],
+            samples_per_frame=config["samples_per_frame"])
+
+
 CONDITIONAL_ENCODERS_REGISTRY: Dict[str, Any] = {
     "clip": CLIPTextEncoder,
     "hash": HashTextEncoder,
     # reference keys encoders by batch key 'text' (encoders.py:96-98)
     "text": CLIPTextEncoder,
+    "mel_audio": MelAudioEncoder,
+    "audio": MelAudioEncoder,
 }

@@ -1,0 +1,162 @@
+"""Profiling and MFU accounting.
+
+The reference has no profiling at all (reference trainer/simple_trainer.py
+logs wall-clock epoch time only; no jax.profiler anywhere) — this module is
+the TPU-native observability layer SURVEY §5.1 calls for: per-step FLOPs
+from XLA's own cost model, model-FLOPs-utilization against the chip's peak,
+and `jax.profiler` trace capture for xplane/perfetto inspection.
+
+Usage:
+    flops = compiled_flops(jitted_step, state, batch)   # per-device FLOPs
+    meter = MFUMeter(flops_per_step=flops)
+    with meter.step():                                  # times one step
+        loss = step(...)
+    meter.mfu()                                         # fraction of peak
+
+    with trace("/tmp/trace"):                           # profiler capture
+        run_steps()
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Optional
+
+import jax
+
+# Peak dense matmul throughput per chip, FLOP/s. bf16 (the MXU-native
+# dtype this framework trains in). Public numbers from Google's TPU
+# system documentation.
+_PEAK_FLOPS_BF16 = {
+    "TPU v2": 46e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # v5p (kind string "TPU v5")
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,   # v6e / Trillium
+    "TPU v6e": 918e12,
+    "TPU7x": 2307e12,
+}
+
+
+def device_peak_flops(device: Optional[Any] = None) -> Optional[float]:
+    """Peak bf16 FLOP/s of `device` (default: first local device).
+
+    Returns None on hosts where the peak is unknown (e.g. CPU test
+    meshes) — MFU is then unreportable rather than wrong."""
+    if device is None:
+        device = jax.local_devices()[0]
+    kind = getattr(device, "device_kind", "")
+    if kind in _PEAK_FLOPS_BF16:
+        return _PEAK_FLOPS_BF16[kind]
+    # longest-prefix fallback ("TPU v5 lite chip" style variants)
+    best = None
+    for name, flops in _PEAK_FLOPS_BF16.items():
+        if kind.startswith(name) and (best is None or len(name) > best[0]):
+            best = (len(name), flops)
+    return best[1] if best else None
+
+
+def compiled_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
+    """Per-device FLOPs of one execution of `jitted_fn(*args, **kwargs)`.
+
+    Uses XLA's cost analysis on the compiled executable — the same numbers
+    the compiler schedules against, so rematerialization (jax.checkpoint)
+    and fusion decisions are included, unlike hand-derived analytic counts.
+    Under SPMD jit the executable is the per-device program, so the figure
+    is already per-chip. Returns None if the backend exposes no analysis.
+    """
+    try:
+        compiled = jitted_fn.lower(*args, **kwargs).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returned [dict]
+            cost = cost[0] if cost else {}
+        flops = cost.get("flops")
+        return float(flops) if flops and flops > 0 else None
+    except Exception:
+        return None
+
+
+def mfu(flops_per_step: float, step_time_s: float,
+        peak_flops: Optional[float] = None) -> Optional[float]:
+    """Model FLOPs utilization: achieved FLOP/s over peak FLOP/s."""
+    if peak_flops is None:
+        peak_flops = device_peak_flops()
+    if not peak_flops or step_time_s <= 0:
+        return None
+    return flops_per_step / step_time_s / peak_flops
+
+
+class MFUMeter:
+    """Accumulates step timings and reports throughput + MFU.
+
+    `flops_per_step` is per-device FLOPs (from `compiled_flops`); timings
+    are wall-clock per step. Call `.observe(dt)` or use `.step()` as a
+    context manager around one synchronous step."""
+
+    def __init__(self, flops_per_step: Optional[float] = None,
+                 peak_flops: Optional[float] = None):
+        self.flops_per_step = flops_per_step
+        self.peak_flops = peak_flops if peak_flops is not None \
+            else device_peak_flops()
+        self.total_time = 0.0
+        self.steps = 0
+
+    def observe(self, dt: float, steps: int = 1):
+        self.total_time += dt
+        self.steps += steps
+
+    @contextlib.contextmanager
+    def step(self):
+        t0 = time.perf_counter()
+        yield
+        self.observe(time.perf_counter() - t0)
+
+    def mean_step_time(self) -> Optional[float]:
+        return self.total_time / self.steps if self.steps else None
+
+    def mfu(self) -> Optional[float]:
+        dt = self.mean_step_time()
+        if dt is None or self.flops_per_step is None:
+            return None
+        return mfu(self.flops_per_step, dt, self.peak_flops)
+
+    def achieved_tflops(self) -> Optional[float]:
+        dt = self.mean_step_time()
+        if dt is None or self.flops_per_step is None:
+            return None
+        return self.flops_per_step / dt / 1e12
+
+    def reset(self):
+        self.total_time = 0.0
+        self.steps = 0
+
+
+@contextlib.contextmanager
+def trace(logdir: str, host_tracer_level: int = 2):
+    """jax.profiler capture around a block; view with xprof/tensorboard
+    or perfetto. No-op context if the profiler cannot start (e.g. a
+    second concurrent trace)."""
+    started = False
+    try:
+        jax.profiler.start_trace(logdir)
+        started = True
+    except Exception:
+        pass
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named TraceAnnotation visible in profiler timelines."""
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -23,6 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel import fsdp_sharding_tree, sharding_tree
 from ..parallel.mesh import batch_spec
+from ..profiling import MFUMeter, compiled_flops
 from ..predictors import PredictionTransform
 from ..schedulers.common import NoiseSchedule
 from ..typing import Policy, PyTree
@@ -104,6 +105,19 @@ class DiffusionTrainer:
 
         self.best_loss = float("inf")
         self.best_state: Optional[TrainState] = None
+        self._step_flops: Dict[Any, Optional[float]] = {}
+
+    # -- profiling -----------------------------------------------------------
+    def step_flops(self, global_batch: PyTree) -> Optional[float]:
+        """Per-device FLOPs of the compiled train step (XLA cost analysis);
+        cached per batch shape. None on backends without a cost model."""
+        batch = self._numeric_subtree(global_batch)
+        key = tuple((jax.tree_util.keystr(p), x.shape)
+                    for p, x in jax.tree_util.tree_flatten_with_path(batch)[0])
+        if key not in self._step_flops:
+            self._step_flops[key] = compiled_flops(self._step, self.state,
+                                                   batch)
+        return self._step_flops[key]
 
     # -- checkpointing -------------------------------------------------------
     def save_checkpoint(self, force: bool = False) -> bool:
@@ -191,7 +205,9 @@ class DiffusionTrainer:
         losses, log_t0 = [], time.perf_counter()
         steps_in_window = 0
         pending_loss = None
-        history: Dict[str, Any] = {"steps": [], "loss": [], "imgs_per_sec": []}
+        meter = MFUMeter()
+        history: Dict[str, Any] = {"steps": [], "loss": [], "imgs_per_sec": [],
+                                   "mfu": []}
 
         for i in range(total_steps):
             batch = next(data)
@@ -211,12 +227,21 @@ class DiffusionTrainer:
                 bsz = jax.tree_util.tree_leaves(batch)[0].shape[0] \
                     * jax.process_count()
                 ips = steps_in_window * bsz / max(dt, 1e-9)
+                if meter.flops_per_step is None and meter.peak_flops:
+                    meter.flops_per_step = self.step_flops(global_batch)
+                meter.reset()
+                meter.observe(dt, steps_in_window)
+                step_mfu = meter.mfu()
                 steps_in_window = 0
                 history["steps"].append(i + 1)
                 history["loss"].append(loss)
                 history["imgs_per_sec"].append(ips)
+                history["mfu"].append(step_mfu)
+                metrics = {"imgs_per_sec": ips}
+                if step_mfu is not None:
+                    metrics["mfu"] = step_mfu
                 for cb in callbacks:
-                    cb(i + 1, loss, {"imgs_per_sec": ips})
+                    cb(i + 1, loss, metrics)
                 if cfg.keep_best_state and loss < self.best_loss:
                     self.best_loss = loss
                     self.best_state = jax.tree_util.tree_map(

@@ -1,0 +1,134 @@
+"""Run the ACTUAL reference (FlaxDiff @ /root/reference) train step on this
+chip to anchor bench.py's `vs_baseline`.
+
+Builds the reference's own `DiffusionTrainer`/`Unet`/`CosineNoiseScheduler`
+(reference flaxdiff/trainer/diffusion_trainer.py:41-258,
+models/simple_unet.py:11) with its CLI-default config at 128x128
+(training.py:139-165: f32, NormalAttention, only_pure_attention, heads 8)
+and times the jitted step exactly as the reference's train_loop drives it —
+including the per-step loss readback its NaN check forces
+(simple_trainer.py:542). Text conditioning goes through a stub encoder so
+the step consumes precomputed CLIP-shaped embeddings, same as bench.py.
+
+Prints one JSON line: {"imgs_per_sec_per_chip": N, "batch": B, ...}.
+
+FINDING (2026-07, jax 0.9.0 / flax 0.12.3): the reference's train step
+does not trace under the versions in this image — its CFG splice
+`null_labels_seq[:num_unconditional]` (diffusion_trainer.py:190) slices
+by a traced int32 and modern JAX rejects it (IndexError: Slice entries
+must be static integers). This matches the reference README's own note
+that jax>=0.4.30 "stopped training" (README.md:117-119). The script is
+kept as the attempt artifact; on failure it emits {"error": ...} and
+bench.py's baseline stays "reference execution semantics re-created on
+this framework" (f32, XLA attention, per-step host sync), stated in its
+`baseline_kind` field.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/reference")
+
+BATCH = 16
+IMAGE_SIZE = 128
+TEXT_LEN = 77
+TEXT_DIM = 768
+WARMUP = 3
+TIMED = 30
+
+
+class StubEncoder:
+    """Stands in for the CLIP tower (offline image): tokens ARE embeddings."""
+
+    def __call__(self, texts):
+        return np.zeros((len(texts), TEXT_LEN, TEXT_DIM), np.float32)
+
+    def encode_from_tokens(self, tokens):
+        return tokens
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from flaxdiff.models.simple_unet import Unet
+    from flaxdiff.predictors import EpsilonPredictionTransform
+    from flaxdiff.schedulers import CosineNoiseScheduler
+    from flaxdiff.trainer.diffusion_trainer import DiffusionTrainer
+    from flaxdiff.utils import RandomMarkovState
+
+    attn = {"heads": 8, "flash_attention": False, "use_projection": False,
+            "use_self_and_cross": True, "only_pure_attention": True,
+            "dtype": None}
+    model = Unet(
+        output_channels=3,
+        emb_features=512,
+        feature_depths=[64, 128, 256, 512],
+        attention_configs=[None, None, dict(attn), dict(attn)],
+        num_res_blocks=2,
+    )
+    trainer = DiffusionTrainer(
+        model=model,
+        input_shapes={"x": (IMAGE_SIZE, IMAGE_SIZE, 3), "temb": (),
+                      "textcontext": (TEXT_LEN, TEXT_DIM)},
+        optimizer=optax.adamw(1e-4),
+        noise_schedule=CosineNoiseScheduler(1000),
+        rngs=jax.random.PRNGKey(0),
+        encoder=StubEncoder(),
+        wandb_config=None,
+        distributed_training=False,
+        checkpoint_base_path="/tmp/refbench_ckpt",
+    )
+    step_fn = trainer._define_train_step(BATCH)
+    state = trainer.state
+    rng_state = RandomMarkovState(jax.random.PRNGKey(1))
+
+    rng = np.random.default_rng(0)
+    batches = [{
+        "image": rng.integers(0, 256, size=(
+            BATCH, IMAGE_SIZE, IMAGE_SIZE, 3)).astype(np.float32),
+        "text": rng.normal(size=(BATCH, TEXT_LEN, TEXT_DIM)).astype(
+            np.float32),
+    } for _ in range(4)]
+
+    for i in range(WARMUP):
+        state, loss, rng_state = step_fn(
+            state, rng_state, dict(batches[i % len(batches)]), 0)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(TIMED):
+        state, loss, rng_state = step_fn(
+            state, rng_state, dict(batches[i % len(batches)]), 0)
+        # reference train_loop semantics: per-step abnormal-loss check
+        # (simple_trainer.py:542) forces a host sync
+        assert float(loss) > 1e-8
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.local_device_count()
+    print(json.dumps({
+        "imgs_per_sec_per_chip": round(TIMED * BATCH / dt / n_chips, 3),
+        "batch": BATCH,
+        "step_time_ms": round(dt / TIMED * 1e3, 2),
+        "config": "reference CLI defaults (f32, NormalAttention, "
+                  "only_pure_attention)",
+    }))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # see FINDING in module docstring
+        print(json.dumps({
+            "error": f"{type(e).__name__}: {str(e)[:200]}",
+            "conclusion": "reference code cannot run under jax 0.9 / "
+                          "flax 0.12 (version-pinned, per its README); "
+                          "bench.py baseline uses reference execution "
+                          "semantics on the new framework instead",
+        }))
