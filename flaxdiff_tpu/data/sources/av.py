@@ -475,8 +475,15 @@ class AVSyncSource(VideoFolderSource):
         if choices:
             wlo, whi = choices[int(rng.integers(len(choices)))]
             wrong_t = float(rng.uniform(wlo, whi))
-        else:  # farthest possible, mirroring the reference fallback
-            wrong_t = lo if start_t > duration / 2 else hi
+        else:
+            # start_t landed where neither side leaves a clip_dur gap.
+            # The duration guard proves a non-overlapping pair exists when
+            # the instance starts at lo, so re-anchor instead of returning
+            # an overlapping (contaminated) negative.
+            start_t = lo
+            right_lo = start_t + clip_dur
+            wrong_t = (float(rng.uniform(right_lo, hi))
+                       if hi > right_lo else right_lo)
         times = start_t + np.arange(num_frames) / target_fps
         wrong_times = wrong_t + np.arange(num_frames) / target_fps
         frames = _read_frames_at_times(path, times, native)

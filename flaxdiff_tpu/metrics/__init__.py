@@ -6,8 +6,9 @@ from .clip_metrics import (
     get_clip_score_metric,
 )
 from .common import EvaluationMetric, MetricTracker
-from .fid import FeatureStats, FIDComputer, frechet_distance
-from .inception import InceptionV3Features, make_inception_extractor
+from .fid import FeatureStats, FIDComputer, frechet_distance, get_fid_metric
+from .inception import (InceptionV3Features, convert_torch_state_dict,
+                        load_inception_params, make_inception_extractor)
 
 __all__ = [
     "EvaluationMetric",
@@ -15,7 +16,10 @@ __all__ = [
     "FeatureStats",
     "FIDComputer",
     "frechet_distance",
+    "get_fid_metric",
     "InceptionV3Features",
+    "convert_torch_state_dict",
+    "load_inception_params",
     "make_inception_extractor",
     "cosine_similarity",
     "clip_score",

@@ -105,3 +105,39 @@ class FIDComputer:
 
     def reset_generated(self):
         self.fake = FeatureStats()
+
+
+def get_fid_metric(extractor: Optional[Callable] = None,
+                   params_file: Optional[str] = None,
+                   batch_size: int = 32,
+                   real_key: str = "sample"):
+    """EvaluationMetric: FID between generated samples and the validation
+    batch's real images (lower is better). Finishes the wiring the
+    reference never did (its InceptionV3 port is called by no trainer,
+    reference metrics/inception.py:22-657 / SURVEY §5.5).
+
+    `extractor` defaults to InceptionV3 pool3 features; pass
+    `params_file` (scripts/convert_inception_weights.py output) for
+    standard FID — random-init features otherwise (relative use only).
+    Real-side stats accumulate ACROSS validation calls, so the reference
+    distribution sharpens as training proceeds; generated stats reset
+    each call. In-loop validation FID at small sample counts (n << 2048
+    feature dims) is rank-deficient and only indicative — for reportable
+    FID-10k, drive FIDComputer directly over >= 10k samples."""
+    from .common import EvaluationMetric
+    if extractor is None:
+        from .inception import make_inception_extractor
+        extractor = make_inception_extractor(params_file=params_file)
+    computer = FIDComputer(extractor, batch_size=batch_size)
+    from ..utils import to_unit_float
+
+    def fn(samples, batch):
+        if batch is None or real_key not in batch:
+            raise ValueError(
+                f"FID metric needs real images under batch[{real_key!r}]")
+        computer.reset_generated()
+        computer.add_real(to_unit_float(batch[real_key]))
+        computer.add_generated(to_unit_float(samples))
+        return computer.compute()
+
+    return EvaluationMetric(function=fn, name="fid", higher_is_better=False)

@@ -171,25 +171,105 @@ class InceptionV3Features(nn.Module):
         return jnp.mean(x, axis=(1, 2))   # global average pool -> [N, 2048]
 
 
+# -- pretrained-weight plumbing ---------------------------------------------
+#
+# pytorch-FID's state-dict names map 1:1 onto this module tree:
+#   <mod>.conv.weight        -> params/<mod>/conv/kernel   (OIHW -> HWIO)
+#   <mod>.bn.weight / .bias  -> params/<mod>/bn/scale|bias
+#   <mod>.bn.running_mean/var-> batch_stats/<mod>/bn/mean|var
+# where <mod> is e.g. "Conv2d_1a_3x3" or "Mixed_5b.branch1x1". The fc
+# classifier head and AuxLogits tower are not part of the pool3 feature
+# path and are skipped.
+
+_TORCH_SKIP_PREFIXES = ("fc.", "AuxLogits.")
+
+
+def convert_torch_state_dict(state) -> dict:
+    """{torch name: array} -> {'/'-joined flax path: np.ndarray}.
+
+    Pure array/naming transform (no torch import) so the mapping is unit
+    testable offline; scripts/convert_inception_weights.py feeds it a
+    loaded checkpoint. Raises on names it does not understand rather than
+    silently dropping weights."""
+    out = {}
+    for name, value in state.items():
+        if name.startswith(_TORCH_SKIP_PREFIXES):
+            continue
+        if name.endswith("num_batches_tracked"):
+            continue
+        value = np.asarray(value)
+        parts = name.split(".")
+        mod, leaf = parts[:-2], parts[-2:]
+        if leaf == ["conv", "weight"]:
+            out["/".join(["params", *mod, "conv", "kernel"])] = \
+                value.transpose(2, 3, 1, 0)   # OIHW -> HWIO
+        elif leaf == ["bn", "weight"]:
+            out["/".join(["params", *mod, "bn", "scale"])] = value
+        elif leaf == ["bn", "bias"]:
+            out["/".join(["params", *mod, "bn", "bias"])] = value
+        elif leaf == ["bn", "running_mean"]:
+            out["/".join(["batch_stats", *mod, "bn", "mean"])] = value
+        elif leaf == ["bn", "running_var"]:
+            out["/".join(["batch_stats", *mod, "bn", "var"])] = value
+        else:
+            raise ValueError(f"unmapped torch parameter name: {name!r}")
+    return out
+
+
+def load_inception_params(variables, params_file: str):
+    """Load a converted .npz into the module's variables by PATH — every
+    expected leaf must be present with a matching shape (fixes the
+    order-based unflatten the round-1 review flagged: flax tree order is
+    not lexicographic path order)."""
+    loaded = dict(np.load(params_file))
+    flat, treedef = jax.tree_util.tree_flatten_with_path(variables)
+    missing, mismatched = [], []
+    leaves = []
+    for path, leaf in flat:
+        key = "/".join(
+            getattr(p, "key", getattr(p, "name", str(p))) for p in path)
+        if key not in loaded:
+            missing.append(key)
+            leaves.append(leaf)
+            continue
+        arr = loaded.pop(key)
+        if tuple(arr.shape) != tuple(leaf.shape):
+            mismatched.append(f"{key}: file {arr.shape} vs "
+                              f"model {tuple(leaf.shape)}")
+            leaves.append(leaf)
+            continue
+        leaves.append(jnp.asarray(arr, dtype=leaf.dtype))
+    errors = []
+    if missing:
+        errors.append(f"missing from file: {sorted(missing)[:5]}"
+                      f"{' ...' if len(missing) > 5 else ''} "
+                      f"({len(missing)} total)")
+    if mismatched:
+        errors.append(f"shape mismatches: {mismatched[:5]}")
+    if loaded:
+        errors.append(f"unused keys in file: {sorted(loaded)[:5]} "
+                      f"({len(loaded)} total)")
+    if errors:
+        raise ValueError("inception weight load failed — "
+                         + "; ".join(errors))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def make_inception_extractor(params_file: Optional[str] = None,
                              seed: int = 0):
     """Build `extractor(images) -> [N, 2048]` for FIDComputer.
 
-    `params_file`: local .npz of flattened '/'-joined param paths (FID
-    weights; no download path exists in this environment). Without it the
-    network is random-init — deterministic per seed, usable as a fixed
-    random-feature extractor for relative comparisons, NOT standard FID.
+    `params_file`: local .npz produced by
+    scripts/convert_inception_weights.py (FID weights; no download path
+    exists in this environment). Without it the network is random-init —
+    deterministic per seed, usable as a fixed random-feature extractor
+    for relative comparisons, NOT standard FID.
     """
     model = InceptionV3Features()
     dummy = jnp.zeros((1, 299, 299, 3))
     variables = model.init(jax.random.PRNGKey(seed), dummy)
     if params_file is not None:
-        loaded = np.load(params_file)
-        flat = {tuple(k.split("/")): jnp.asarray(v)
-                for k, v in loaded.items()}
-        variables = jax.tree_util.tree_unflatten(
-            jax.tree_util.tree_structure(variables),
-            [flat[p] for p in sorted(flat)])
+        variables = load_inception_params(variables, params_file)
 
     @jax.jit
     def extractor(images):

@@ -14,8 +14,37 @@ import time
 from typing import Any, Dict, Optional, Sequence
 
 
+def save_image_grid(images, path: str, pad: int = 2) -> str:
+    """Tile [N, H, W, C] (uint8 or [-1,1]/[0,1] float) into one PNG."""
+    import math
+
+    import cv2
+    import numpy as np
+    imgs = np.asarray(images)
+    if imgs.ndim == 5:           # video [N, F, H, W, C]: lay frames out
+        imgs = imgs.reshape(-1, *imgs.shape[2:])
+    if imgs.dtype != np.uint8:
+        from ..utils import to_unit_float
+        imgs = (to_unit_float(imgs) * 255).astype(np.uint8)
+    n, h, w, c = imgs.shape
+    cols = int(math.ceil(math.sqrt(n)))
+    rows = int(math.ceil(n / cols))
+    grid = np.zeros((rows * (h + pad) - pad, cols * (w + pad) - pad, c),
+                    np.uint8)
+    for i, im in enumerate(imgs):
+        r, col = divmod(i, cols)
+        grid[r * (h + pad):r * (h + pad) + h,
+             col * (w + pad):col * (w + pad) + w] = im
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    cv2.imwrite(path, grid[..., ::-1] if c == 3 else grid)
+    return path
+
+
 class JsonlLogger:
-    """Appends one JSON object per log call — greppable, dependency-free."""
+    """Appends one JSON object per log call — greppable, dependency-free.
+    Image grids are written as PNGs under `<dir>/samples/` and referenced
+    by path in the stream (the offline stand-in for the reference's wandb
+    sample galleries, general_diffusion_trainer.py:521-558)."""
 
     def __init__(self, path: str):
         os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
@@ -37,7 +66,15 @@ class JsonlLogger:
         self._fh.write(json.dumps(rec) + "\n")
 
     def log_images(self, key: str, images, step: Optional[int] = None):
-        self.log({key: f"<{getattr(images, 'shape', '?')} images>"}, step)
+        name = key.replace("/", "_") + (f"_{step:06d}" if step is not None
+                                        else "")
+        png = os.path.join(os.path.dirname(os.path.abspath(self.path)),
+                           "samples", name + ".png")
+        try:
+            save_image_grid(images, png)
+            self.log({key: png}, step)
+        except Exception as e:  # never let logging kill training
+            self.log({key: f"<grid save failed: {e}>"}, step)
 
     def finish(self):
         self._fh.close()

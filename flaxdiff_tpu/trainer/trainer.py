@@ -23,7 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel import fsdp_sharding_tree, sharding_tree
 from ..parallel.mesh import batch_spec
-from ..profiling import MFUMeter, compiled_flops
+from ..profiling import compiled_flops, device_peak_flops, mfu
 from ..predictors import PredictionTransform
 from ..schedulers.common import NoiseSchedule
 from ..typing import Policy, PyTree
@@ -205,7 +205,8 @@ class DiffusionTrainer:
         losses, log_t0 = [], time.perf_counter()
         steps_in_window = 0
         pending_loss = None
-        meter = MFUMeter()
+        peak = device_peak_flops()
+        flops = None
         history: Dict[str, Any] = {"steps": [], "loss": [], "imgs_per_sec": [],
                                    "mfu": []}
 
@@ -227,11 +228,10 @@ class DiffusionTrainer:
                 bsz = jax.tree_util.tree_leaves(batch)[0].shape[0] \
                     * jax.process_count()
                 ips = steps_in_window * bsz / max(dt, 1e-9)
-                if meter.flops_per_step is None and meter.peak_flops:
-                    meter.flops_per_step = self.step_flops(global_batch)
-                meter.reset()
-                meter.observe(dt, steps_in_window)
-                step_mfu = meter.mfu()
+                if flops is None and peak:
+                    flops = self.step_flops(global_batch)
+                step_mfu = (mfu(flops, dt / steps_in_window, peak)
+                            if flops else None)
                 steps_in_window = 0
                 history["steps"].append(i + 1)
                 history["loss"].append(loss)

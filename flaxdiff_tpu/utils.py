@@ -65,6 +65,22 @@ def clip_images(x: jax.Array, clip_min: float = -1.0, clip_max: float = 1.0) -> 
     return jnp.clip(x, clip_min, clip_max)
 
 
+def to_unit_float(images) -> "np.ndarray":
+    """Any image convention -> float32 [0, 1] (host-side numpy).
+
+    One place for the uint8 / [-1,1]-float / [0,1]-float range heuristic
+    shared by metrics (FID feature input) and logging (grid PNGs), so the
+    two can never disagree about a batch's range."""
+    import numpy as np
+    images = np.asarray(images)
+    if images.dtype == np.uint8:
+        return images.astype(np.float32) / 255.0
+    images = images.astype(np.float32)
+    if images.min() < -0.01:   # [-1,1] convention
+        images = (images + 1.0) / 2.0
+    return np.clip(images, 0.0, 1.0)
+
+
 def cfg_uncond_splice(emb: jax.Array, uncond: jax.Array,
                       uncond_mask: jax.Array) -> jax.Array:
     """CFG-dropout splice: where uncond_mask[b] is True, replace sample b's

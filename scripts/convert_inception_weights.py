@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Convert pytorch-FID InceptionV3 weights to the flaxdiff_tpu .npz format.
+
+Usage:
+    python scripts/convert_inception_weights.py pt_inception-2015-12-05.pth \
+        inception_fid.npz
+
+The input is the pytorch-FID checkpoint (state dict of the modified
+torchvision InceptionV3 that the FID metric standardizes on — the same
+weights the reference downloads in flaxdiff/metrics/utils.py:12-43).
+The name/layout mapping lives in
+flaxdiff_tpu.metrics.inception.convert_torch_state_dict so it is unit
+tested without torch; this script only handles torch deserialization.
+
+After converting, point the metric at the file:
+    make_inception_extractor(params_file="inception_fid.npz")
+or the CLI:
+    python train.py --val_metrics fid --inception_weights inception_fid.npz
+"""
+import sys
+
+import numpy as np
+
+from flaxdiff_tpu.metrics.inception import (InceptionV3Features,
+                                            convert_torch_state_dict,
+                                            load_inception_params)
+
+
+def main():
+    if len(sys.argv) != 3:
+        raise SystemExit(__doc__)
+    src, dst = sys.argv[1], sys.argv[2]
+
+    import torch
+    state = torch.load(src, map_location="cpu", weights_only=True)
+    if hasattr(state, "state_dict"):
+        state = state.state_dict()
+    state = {k: v.numpy() for k, v in state.items()}
+
+    converted = convert_torch_state_dict(state)
+    np.savez(dst, **converted)
+    print(f"wrote {len(converted)} arrays -> {dst}")
+
+    # validate: every model leaf must load by path with matching shape
+    import jax
+    import jax.numpy as jnp
+    model = InceptionV3Features()
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 299, 299, 3)))
+    load_inception_params(variables, dst)
+    print("validation OK: all paths matched with correct shapes")
+
+
+if __name__ == "__main__":
+    main()

@@ -87,3 +87,135 @@ def test_cosine_similarity_and_clip_score():
     np.testing.assert_allclose(cs, [1.0, -1.0, 1.0], atol=1e-6)
     sc = np.asarray(clip_score(a, b))
     np.testing.assert_allclose(sc, [2.5, 0.0, 2.5], atol=1e-5)
+
+
+# -- pretrained-weight conversion (round-2: VERDICT r1 #4) -------------------
+
+def _fake_torch_state_from_variables(variables):
+    """Inverse of convert_torch_state_dict: flax variables -> torch-named
+    state dict with torch layouts, filled with the flax values."""
+    import jax
+    state = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(variables)
+    for path, leaf in flat:
+        keys = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
+        col, *mod, layer, leafname = keys
+        arr = np.asarray(leaf)
+        tname = ".".join(mod)
+        if col == "params" and layer == "conv" and leafname == "kernel":
+            state[f"{tname}.conv.weight"] = arr.transpose(3, 2, 0, 1)
+        elif col == "params" and layer == "bn" and leafname == "scale":
+            state[f"{tname}.bn.weight"] = arr
+        elif col == "params" and layer == "bn" and leafname == "bias":
+            state[f"{tname}.bn.bias"] = arr
+        elif col == "batch_stats" and leafname == "mean":
+            state[f"{tname}.bn.running_mean"] = arr
+        elif col == "batch_stats" and leafname == "var":
+            state[f"{tname}.bn.running_var"] = arr
+        else:
+            raise AssertionError(f"unexpected leaf {keys}")
+    return state
+
+
+def test_inception_weight_conversion_roundtrip(tmp_path):
+    """Every leaf must land on its exact path with its exact value — the
+    order-based unflatten this replaces would silently scramble them."""
+    import jax
+    import jax.numpy as jnp
+    from flaxdiff_tpu.metrics import (InceptionV3Features,
+                                      convert_torch_state_dict,
+                                      load_inception_params)
+
+    model = InceptionV3Features()
+    rng = np.random.default_rng(0)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3)))
+    # randomize so equal-shape leaves are distinguishable
+    variables = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(rng.normal(size=x.shape), x.dtype), variables)
+
+    state = _fake_torch_state_from_variables(variables)
+    # torch checkpoints carry these; the converter must skip them
+    state["fc.weight"] = np.zeros((1008, 2048), np.float32)
+    state["fc.bias"] = np.zeros((1008,), np.float32)
+    state["AuxLogits.conv0.conv.weight"] = np.zeros((1, 1, 1, 1), np.float32)
+    state["Conv2d_1a_3x3.bn.num_batches_tracked"] = np.zeros((), np.int64)
+
+    converted = convert_torch_state_dict(state)
+    f = tmp_path / "inception.npz"
+    np.savez(f, **converted)
+    restored = load_inception_params(variables, str(f))
+
+    flat_a = jax.tree_util.tree_leaves_with_path(variables)
+    flat_b = dict(jax.tree_util.tree_leaves_with_path(restored))
+    assert len(flat_a) == len(flat_b)
+    for path, leaf in flat_a:
+        np.testing.assert_array_equal(np.asarray(leaf),
+                                      np.asarray(flat_b[path]),
+                                      err_msg=str(path))
+
+
+def test_inception_weight_load_rejects_bad_files(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    from flaxdiff_tpu.metrics import (InceptionV3Features,
+                                      convert_torch_state_dict,
+                                      load_inception_params)
+    model = InceptionV3Features()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3)))
+    converted = convert_torch_state_dict(
+        _fake_torch_state_from_variables(variables))
+
+    missing = dict(converted)
+    missing.pop(sorted(missing)[0])
+    f1 = tmp_path / "missing.npz"
+    np.savez(f1, **missing)
+    with pytest.raises(ValueError, match="missing"):
+        load_inception_params(variables, str(f1))
+
+    bad = dict(converted)
+    k = sorted(bad)[0]
+    bad[k] = np.zeros((1, 2, 3), np.float32)
+    f2 = tmp_path / "badshape.npz"
+    np.savez(f2, **bad)
+    with pytest.raises(ValueError, match="mismatch"):
+        load_inception_params(variables, str(f2))
+
+    with pytest.raises(ValueError, match="unmapped"):
+        convert_torch_state_dict({"Mixed_5b.branch1x1.conv.oops":
+                                  np.zeros(1)})
+
+
+def test_fid_metric_wires_into_validation(rng):
+    from flaxdiff_tpu.metrics import get_fid_metric
+
+    def toy_extractor(images):  # cheap stand-in for inception
+        x = np.asarray(images, np.float32).reshape(len(images), -1)
+        return x[:, :8]
+
+    metric = get_fid_metric(extractor=toy_extractor)
+    assert metric.name == "fid" and not metric.higher_is_better
+    real = rng.normal(size=(32, 4, 4, 3)).astype(np.float32).clip(0, 1)
+    same = real + rng.normal(size=real.shape).astype(np.float32) * 0.01
+    far = (real + 0.5).clip(0, 1)
+    close_fid = metric.function(same, {"sample": real})
+    far_fid = metric.function(far, {"sample": real})
+    assert close_fid < far_fid
+    with pytest.raises(ValueError, match="real images"):
+        metric.function(same, None)
+
+
+def test_jsonl_logger_writes_image_grid(tmp_path):
+    from flaxdiff_tpu.trainer.logging import JsonlLogger
+    import json as _json
+    lg = JsonlLogger(str(tmp_path / "log.jsonl"))
+    imgs = (np.random.default_rng(0).random((5, 8, 8, 3)) * 255
+            ).astype(np.uint8)
+    lg.log_images("val/samples", imgs, step=7)
+    lg.finish()
+    rec = [_json.loads(l) for l in open(tmp_path / "log.jsonl")][-1]
+    import os
+    assert rec["step"] == 7
+    assert os.path.exists(rec["val/samples"])
+    import cv2
+    grid = cv2.imread(rec["val/samples"])
+    assert grid is not None and grid.shape[0] >= 8

@@ -60,6 +60,11 @@ def parse_args(argv=None):
     p.add_argument("--val_samples", type=int, default=8)
     p.add_argument("--val_steps", type=int, default=200)
     p.add_argument("--val_guidance", type=float, default=3.0)
+    p.add_argument("--val_metrics", default="",
+                   help="comma list of {fid, clip, clip_score}")
+    p.add_argument("--inception_weights", default=None,
+                   help=".npz from scripts/convert_inception_weights.py "
+                        "(standard FID; random features otherwise)")
     p.add_argument("--sampler", default="euler_ancestral")
     p.add_argument("--wandb_project", default=None)
     p.add_argument("--seed", type=int, default=0)
@@ -181,9 +186,24 @@ def main(argv=None):
 
     validator = None
     if args.val_every:
+        val_metrics = []
+        for name in filter(None, args.val_metrics.split(",")):
+            if name == "fid":
+                from flaxdiff_tpu.metrics import get_fid_metric
+                val_metrics.append(get_fid_metric(
+                    params_file=args.inception_weights))
+            elif name == "clip":
+                from flaxdiff_tpu.metrics import get_clip_metric
+                val_metrics.append(get_clip_metric())
+            elif name == "clip_score":
+                from flaxdiff_tpu.metrics import get_clip_score_metric
+                val_metrics.append(get_clip_score_metric())
+            else:
+                raise SystemExit(f"unknown --val_metrics entry {name!r}")
         validator = Validator(
             model_fn=apply_fn, schedule=schedule, transform=transform,
             sampler=SAMPLER_REGISTRY[args.sampler](),
+            metrics=val_metrics,
             config=ValidationConfig(
                 num_samples=args.val_samples,
                 diffusion_steps=args.val_steps,
@@ -193,10 +213,13 @@ def main(argv=None):
     raw_iter = loaded["train"](seed=args.seed)
 
     def encode_text(batch):
-        """Host-side text encoding: raw caption strings -> embeddings."""
+        """Host-side text encoding: raw caption strings -> embeddings.
+        Raw strings stay in the batch (put_batch strips non-numerics
+        before jit) so validation metrics that need prompts — CLIPScore —
+        still see batch['text']."""
         if encoder is None or "text" not in batch:
             return batch
-        text = batch.pop("text")
+        text = batch["text"]
         if isinstance(text, list):
             batch.setdefault("cond", {})["text"] = np.asarray(
                 encoder(text))
@@ -222,10 +245,15 @@ def main(argv=None):
                 prompts = ["a photo"] * args.val_samples
                 cond = jnp.asarray(encoder(prompts))
                 unc = input_config.get_unconditionals(args.val_samples)[0]
+            real_batch = next(it)  # real images for FID / CLIP references
             result = validator.run(trainer.get_params(use_ema=True),
-                                   conditioning=cond, unconditional=unc)
+                                   conditioning=cond, unconditional=unc,
+                                   batch=real_batch)
             logger.log({f"val/{k}": v
                         for k, v in result["metrics"].items()}, step=done)
+            logger.log_images("val/samples",
+                              Validator.to_uint8(result["samples"]),
+                              step=done)
     logger.log({"final_loss": hist["final_loss"]}, step=done)
     logger.finish()
     ckpt.wait_until_finished()
