@@ -274,6 +274,8 @@ def make_inception_extractor(params_file: Optional[str] = None,
     @jax.jit
     def extractor(images):
         images = jnp.asarray(images)
+        if images.ndim == 5:   # video [N, F, H, W, C]: frames are samples
+            images = images.reshape((-1,) + images.shape[2:])
         if images.dtype == jnp.uint8:
             images = images.astype(jnp.float32) / 255.0
         return model.apply(variables, images)

@@ -15,6 +15,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 @functools.cache
@@ -50,10 +51,32 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           force_fp32_for_softmax: bool = True) -> jax.Array:
     """Multi-head attention over BTNH tensors.
 
-    backend: "flash" (Pallas TPU kernel), "xla", or "auto" (flash on TPU
-    when shapes qualify, else xla).
+    backend: "flash" (Pallas TPU kernel), "xla", "ring" (sequence-parallel
+    ring attention over the active mesh's seq axis — self-attention only),
+    or "auto" (flash on TPU when shapes qualify, else xla).
     """
     assert q.ndim == 4 and k.ndim == 4 and v.ndim == 4
+    if backend == "ring":
+        from ..parallel.context import (get_active_mesh, get_seq_axis,
+                                        seq_parallel_active)
+        # Ring attention needs: a declared mesh with a real seq axis;
+        # equal q/kv sequence lengths (the heuristic separating
+        # self-attention from cross-attention's short unsharded kv); and
+        # shapes that shard evenly — seq divisible by the seq axis, batch
+        # by the data axes. Anything else degrades to "auto" so the model
+        # definition stays valid on single-chip, on CPU tests, and at
+        # levels whose token counts don't tile the ring.
+        mesh = get_active_mesh()
+        if seq_parallel_active() and q.shape[1] == k.shape[1]:
+            seq_axis = get_seq_axis()
+            data_n = int(np.prod([mesh.shape[a] for a in mesh.axis_names
+                                  if a == "data"])) if mesh else 1
+            if (q.shape[1] % mesh.shape[seq_axis] == 0
+                    and q.shape[0] % max(data_n, 1) == 0):
+                from ..parallel.ring_attention import ring_self_attention
+                return ring_self_attention(
+                    q, k, v, mesh, seq_axis=seq_axis, scale=scale)
+        backend = "auto"
     use_flash = False
     if backend in ("auto", "flash") and attention_backend_available("flash"):
         # Sequences shorter than one q block gain nothing from the kernel;

@@ -8,6 +8,13 @@ automatic FSDP sharding inference, and sequence-parallel collectives —
 all through `jax.sharding.NamedSharding` so XLA SPMD emits the
 reduce-scatter/all-gather pattern over ICI.
 """
+from .context import (
+    get_active_mesh,
+    get_seq_axis,
+    seq_parallel_active,
+    set_active_mesh,
+    use_mesh,
+)
 from .mesh import MeshAxes, create_mesh, local_batch_size, mesh_shape_for
 from .ring_attention import (
     ring_attention_sharded,
@@ -27,6 +34,11 @@ from .partition import (
 __all__ = [
     "MeshAxes",
     "create_mesh",
+    "get_active_mesh",
+    "get_seq_axis",
+    "seq_parallel_active",
+    "set_active_mesh",
+    "use_mesh",
     "ring_attention_sharded",
     "ring_self_attention",
     "sequence_sharding",

@@ -1,0 +1,57 @@
+"""Active-mesh context: lets attention modules reach the device mesh.
+
+Flax module trees are built from static config (strings, ints); a Mesh is
+runtime state. The trainer/sampler declare the mesh once here and the
+attention dispatch (`ops/attention.py` backend="ring") picks it up during
+tracing — no mesh threading through every module constructor. This is the
+TPU-native replacement for the reference's pattern of closing the mesh
+over the train step (reference trainer/simple_trainer.py:176,413-415);
+here any module can be sequence-parallel without its parent knowing.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+from typing import Optional
+
+from jax.sharding import Mesh
+
+_active_mesh: contextvars.ContextVar[Optional[Mesh]] = \
+    contextvars.ContextVar("flaxdiff_tpu_active_mesh", default=None)
+_seq_axis: contextvars.ContextVar[str] = \
+    contextvars.ContextVar("flaxdiff_tpu_seq_axis", default="seq")
+
+
+def set_active_mesh(mesh: Optional[Mesh], seq_axis: str = "seq"):
+    """Declare the mesh (and sequence axis name) model code should use.
+    Returns nothing; call with None to clear."""
+    _active_mesh.set(mesh)
+    _seq_axis.set(seq_axis)
+
+
+def get_active_mesh() -> Optional[Mesh]:
+    return _active_mesh.get()
+
+
+def get_seq_axis() -> str:
+    return _seq_axis.get()
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh, seq_axis: str = "seq"):
+    """Scoped variant of set_active_mesh."""
+    tok_m = _active_mesh.set(mesh)
+    tok_s = _seq_axis.set(seq_axis)
+    try:
+        yield mesh
+    finally:
+        _active_mesh.reset(tok_m)
+        _seq_axis.reset(tok_s)
+
+
+def seq_parallel_active() -> bool:
+    """True when a mesh with a >1-sized sequence axis is declared."""
+    mesh = get_active_mesh()
+    axis = get_seq_axis()
+    return (mesh is not None and axis in mesh.axis_names
+            and mesh.shape[axis] > 1)

@@ -115,8 +115,10 @@ class DiffusionTrainer:
         key = tuple((jax.tree_util.keystr(p), x.shape)
                     for p, x in jax.tree_util.tree_flatten_with_path(batch)[0])
         if key not in self._step_flops:
-            self._step_flops[key] = compiled_flops(self._step, self.state,
-                                                   batch)
+            from ..parallel.context import use_mesh
+            with use_mesh(self.mesh):
+                self._step_flops[key] = compiled_flops(
+                    self._step, self.state, batch)
         return self._step_flops[key]
 
     # -- checkpointing -------------------------------------------------------
@@ -187,8 +189,15 @@ class DiffusionTrainer:
         return batch
 
     def train_step(self, batch: PyTree):
-        self.state, loss = self._step(self.state,
-                                      self._numeric_subtree(batch))
+        # Scoped mesh declaration: mesh-aware modules (attention backend
+        # "ring") read it during the lazy first-call trace. Scoping per
+        # call (rather than a global set in __init__) keeps two trainers
+        # with different meshes in one process from cross-capturing, and
+        # works when steps are driven from a worker thread.
+        from ..parallel.context import use_mesh
+        with use_mesh(self.mesh):
+            self.state, loss = self._step(self.state,
+                                          self._numeric_subtree(batch))
         return loss
 
     def fit(self,

@@ -66,18 +66,23 @@ def clip_images(x: jax.Array, clip_min: float = -1.0, clip_max: float = 1.0) -> 
 
 
 def to_unit_float(images) -> "np.ndarray":
-    """Any image convention -> float32 [0, 1] (host-side numpy).
+    """uint8 / [-1,1] / [0,1] / [0,255]-float images -> float32 [0, 1]
+    (host-side numpy).
 
-    One place for the uint8 / [-1,1]-float / [0,1]-float range heuristic
-    shared by metrics (FID feature input) and logging (grid PNGs), so the
-    two can never disagree about a batch's range."""
+    One place for the range heuristic shared by metrics (FID feature
+    input) and logging (grid PNGs), so the two can never disagree about a
+    batch's range. Float ranges are detected by value: min < -0.01 means
+    [-1,1]; max > 1.5 means [0,255] (un-normalized decode output); else
+    already [0,1]."""
     import numpy as np
     images = np.asarray(images)
     if images.dtype == np.uint8:
         return images.astype(np.float32) / 255.0
     images = images.astype(np.float32)
-    if images.min() < -0.01:   # [-1,1] convention
+    if images.min() < -0.01:       # [-1,1] convention
         images = (images + 1.0) / 2.0
+    elif images.max() > 1.5:       # float [0,255] convention
+        images = images / 255.0
     return np.clip(images, 0.0, 1.0)
 
 
