@@ -1,0 +1,56 @@
+"""Pipelined host-side transforms: overlap per-batch CPU work (text
+encoding, augmentation) with device steps.
+
+SURVEY §7.3(4): the reference runs its CLIP text tower INSIDE the jitted
+train step (reference general_diffusion_trainer.py:275,292), spending MXU
+cycles on a frozen encoder every step; round-1 of this framework encoded
+on the host synchronously, serializing input against the device. This
+module is the third option: encode on the host in a background thread,
+`depth` batches ahead, so encoding cost hides behind device compute
+entirely when encode_time <= step_time (measured: a CLIP-L text tower on
+77 tokens is ~5-15 ms on host vs ~100+ ms UNet steps, so prefetch wins
+over in-jit — which also pays HBM for the frozen tower's weights — and
+over blocking host encode; see bench note in scripts/bench_text_encode.py).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, TypeVar
+
+T = TypeVar("T")
+U = TypeVar("U")
+
+_SENTINEL = object()
+
+
+def prefetch_map(fn: Callable[[T], U], it: Iterator[T],
+                 depth: int = 2) -> Iterator[U]:
+    """Apply `fn` to items of `it` in a daemon thread, keeping up to
+    `depth` results ready. Order-preserving. Exceptions in `fn` or the
+    source iterator re-raise at the consumer's next() (the data-layer
+    fault-surfacing behavior of reference online_loader.py:980-988)."""
+    if depth < 1:
+        raise ValueError("depth must be >= 1")
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+
+    def worker():
+        try:
+            for item in it:
+                q.put(fn(item))
+        except BaseException as e:  # surfaced on the consumer side
+            q.put((_SENTINEL, e))
+            return
+        q.put((_SENTINEL, None))
+
+    t = threading.Thread(target=worker, daemon=True,
+                         name="flaxdiff-prefetch")
+    t.start()
+
+    while True:
+        got = q.get()
+        if isinstance(got, tuple) and len(got) == 2 and got[0] is _SENTINEL:
+            if got[1] is not None:
+                raise got[1]
+            return
+        yield got

@@ -80,14 +80,11 @@ class DiffusionInferencePipeline:
         with open(cfg_path) as f:
             config = json.load(f)
 
-        import orbax.checkpoint as ocp
         from ..trainer.checkpoints import Checkpointer
         ckpt = Checkpointer(checkpoint_dir)
-        step = ckpt.latest_step() if step is None else step
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint in {checkpoint_dir}")
-        restored = ckpt._mgr.restore(step)   # structure-free restore
-        state = restored["state"]
+        # topology-free host restore: inference may run on a different
+        # device layout than training wrote the shards from
+        state, _meta = ckpt.restore_to_host(step)
         params = state["params"]
         ema = state.get("ema_params")
         ckpt.close()

@@ -35,9 +35,20 @@ from ..utils import RngSeq, clip_images
 
 def get_timestep_spacing(method: str, num_steps: int, timesteps: int,
                          start: Optional[float] = None,
-                         end: float = 0.0, rho: float = 7.0) -> jnp.ndarray:
+                         end: float = 0.0, rho: float = 7.0,
+                         schedule: Optional[NoiseSchedule] = None
+                         ) -> jnp.ndarray:
     """Return [num_steps+1] descending step values in the schedule's domain,
-    ending at `end` (terminal). method: linear|quadratic|karras|exponential."""
+    ending at `end` (terminal). method: linear|quadratic|karras|exponential.
+
+    "karras" is rho-spacing in SIGMA domain (Karras et al. 2022 eq. 5:
+    sigma_i = (sigma_max^(1/rho) + i/N (sigma_min^(1/rho) -
+    sigma_max^(1/rho)))^rho), which is what the reference computes
+    (reference samplers/common.py:210-227) — it needs the schedule to map
+    sigma back to t. Pass a SigmaSchedule (exposing sigmas /
+    timesteps_from_sigmas); without one, rho-spacing falls back to the
+    t-domain approximation (exact only for schedules whose sigma is
+    already a rho-power of t)."""
     hi = float(timesteps - 1) if start is None else float(start)
     lo = float(end)
     if method == "linear":
@@ -48,11 +59,21 @@ def get_timestep_spacing(method: str, num_steps: int, timesteps: int,
         steps = jnp.exp(jnp.linspace(jnp.log(hi + 1.0), jnp.log(lo + 1.0),
                                      num_steps + 1)) - 1.0
     elif method == "karras":
-        # rho-spaced in (t+1)^(1/rho); for KarrasVE schedules (already
-        # rho-spaced in sigma over t) linear is the canonical choice.
         inv = 1.0 / rho
-        steps = (jnp.linspace((hi + 1.0) ** inv, (lo + 1.0) ** inv,
-                              num_steps + 1)) ** rho - 1.0
+        if schedule is not None and hasattr(schedule, "sigmas") \
+                and hasattr(schedule, "timesteps_from_sigmas"):
+            # sigma-domain rho spacing, mapped back through the
+            # schedule's inverse (the reference's semantics)
+            sig_hi = schedule.sigmas(jnp.asarray(hi))
+            sig_lo = schedule.sigmas(jnp.asarray(lo))
+            sig = (jnp.linspace(sig_hi ** inv, sig_lo ** inv,
+                                num_steps + 1)) ** rho
+            steps = schedule.timesteps_from_sigmas(sig)
+        else:
+            # t-domain approximation (round-1 behavior); exact when
+            # sigma(t) is itself a rho-power ramp (KarrasVE schedules)
+            steps = (jnp.linspace((hi + 1.0) ** inv, (lo + 1.0) ** inv,
+                                  num_steps + 1)) ** rho - 1.0
     else:
         raise ValueError(f"Unknown timestep spacing {method!r}")
     return steps
@@ -155,7 +176,8 @@ class DiffusionSampler:
             return self._compiled[cache_key]
 
         steps = get_timestep_spacing(self.timestep_spacing, num_steps,
-                                     self.schedule.timesteps, start, end)
+                                     self.schedule.timesteps, start, end,
+                                     schedule=self.schedule)
 
         def program(params, x_init, key, cond, uncond):
             denoise = self._denoise_fn(params, cond, uncond)

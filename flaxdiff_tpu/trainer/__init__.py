@@ -8,7 +8,8 @@ a sharded pytree update, CFG dropout by `jnp.where` null-embedding mask,
 and no per-step host sync (loss is read back only at the log cadence).
 """
 from .checkpoints import Checkpointer, abstract_state_like
-from .logging import JsonlLogger, MultiLogger, WandbLogger, make_logger
+from .logging import JsonlLogger, MultiLogger, WandbLogger, make_logger, save_image_grid
+from .registry import ModelRegistry
 from .train_state import TrainState
 from .train_step import TrainStepConfig, make_train_step
 from .trainer import DiffusionTrainer, TrainerConfig
@@ -28,4 +29,6 @@ __all__ = [
     "WandbLogger",
     "MultiLogger",
     "make_logger",
+    "save_image_grid",
+    "ModelRegistry",
 ]

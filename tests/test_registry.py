@@ -1,0 +1,67 @@
+"""Model registry tests (flaxdiff_tpu/trainer/registry.py)."""
+import json
+
+import numpy as np
+
+from flaxdiff_tpu.trainer import ModelRegistry
+
+
+def test_registry_tracks_direction_aware_best(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "registry.json"))
+    r1 = reg.register_run("run_a", checkpoint_dir="/ckpt/a", step=100,
+                          metrics={"fid": 40.0, "clip_score": 0.2},
+                          metric_directions={"fid": False,
+                                             "clip_score": True})
+    assert r1 == {"fid": True, "clip_score": True}  # first run is best
+
+    r2 = reg.register_run("run_b", checkpoint_dir="/ckpt/b", step=100,
+                          metrics={"fid": 55.0, "clip_score": 0.3},
+                          metric_directions={"fid": False,
+                                             "clip_score": True})
+    assert r2 == {"fid": False, "clip_score": True}
+
+    assert reg.best_run("fid")["run"] == "run_a"
+    assert reg.best_run("clip_score")["run"] == "run_b"
+    assert reg.best_checkpoint("fid") == "/ckpt/a"
+    assert reg.best_run("nope") is None
+
+
+def test_registry_persists_and_reloads(tmp_path):
+    path = str(tmp_path / "registry.json")
+    ModelRegistry(path).register_run(
+        "r", checkpoint_dir="/c", step=5, metrics={"loss": 0.5})
+    reloaded = ModelRegistry(path)
+    assert "r" in reloaded.runs()
+    assert reloaded.best_run("loss")["value"] == 0.5
+    # updating the same run with a worse loss keeps the best pointer
+    became = reloaded.register_run("r2", checkpoint_dir="/c2", step=9,
+                                   metrics={"loss": 0.9})
+    assert became["loss"] is False
+    # file is valid json on disk
+    data = json.load(open(path))
+    assert set(data) >= {"runs", "best"}
+
+
+def test_registry_push_artifact_offline_is_false(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "registry.json"))
+    assert reg.push_artifact("r", str(tmp_path)) is False
+
+
+def test_cli_writes_registry(tmp_path):
+    import sys
+    sys.path.insert(0, ".")
+    import train
+    hist = train.main([
+        "--dataset", "synthetic", "--image_size", "16",
+        "--batch_size", "16", "--architecture", "unet",
+        "--model_config", json.dumps({
+            "feature_depths": [8, 16], "attention_configs": [None, None],
+            "emb_features": 16, "num_res_blocks": 1}),
+        "--total_steps", "4", "--log_every", "2", "--warmup_steps", "2",
+        "--save_every", "100", "--text_encoder", "none",
+        "--checkpoint_dir", str(tmp_path / "runs" / "exp1"),
+        "--run_name", "exp1"])
+    assert np.isfinite(hist["final_loss"])
+    reg = ModelRegistry(str(tmp_path / "runs" / "registry.json"))
+    assert "exp1" in reg.runs()
+    assert reg.best_run("loss")["run"] == "exp1"

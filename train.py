@@ -67,6 +67,10 @@ def parse_args(argv=None):
                         "(standard FID; random features otherwise)")
     p.add_argument("--sampler", default="euler_ancestral")
     p.add_argument("--wandb_project", default=None)
+    p.add_argument("--registry", default=None,
+                   help="path to registry.json for cross-run best tracking "
+                        "(default: <checkpoint_dir>/../registry.json)")
+    p.add_argument("--run_name", default=None)
     p.add_argument("--seed", type=int, default=0)
     return p.parse_args(argv)
 
@@ -115,14 +119,43 @@ def main(argv=None):
         sample_data_shape=(args.image_size, args.image_size, 3),
         conditions=conditions)
 
-    # data: tokenizer-free loader; text encoded host-side per batch
-    dataset = get_dataset(args.dataset, image_size=args.image_size,
-                          **({"root": args.dataset_path}
-                             if args.dataset_path else {}))
-    loaded = get_dataset_grain(dataset, batch_size=args.batch_size,
-                               image_size=args.image_size,
-                               worker_count=args.grain_workers,
-                               seed=args.seed)
+    # data: tokenizer-free loader; text encoded host-side per batch.
+    # "online:<name>" streams through OnlineStreamingDataLoader — a
+    # registry name stays hermetic (records from the in-memory source),
+    # anything else is fetched as a HuggingFace dataset (reference
+    # onlineDatasetMap, online_loader.py:899-921).
+    if args.dataset.startswith("online:"):
+        from flaxdiff_tpu.data.dataloaders import to_trainer_batch
+        from flaxdiff_tpu.data.dataset_map import DATASET_REGISTRY
+        from flaxdiff_tpu.data.online_loader import OnlineStreamingDataLoader
+        name = args.dataset.split(":", 1)[1]
+        if name in DATASET_REGISTRY:
+            media = get_dataset(name, image_size=args.image_size,
+                                **({"root": args.dataset_path}
+                                   if args.dataset_path else {}))
+            src = media.source.get_source()
+            records = [src[i] for i in range(len(src))]
+            online = OnlineStreamingDataLoader(
+                records, batch_size=args.batch_size,
+                image_size=args.image_size, seed=args.seed)
+        else:
+            online = OnlineStreamingDataLoader.from_hf_dataset(
+                name, batch_size=args.batch_size,
+                image_size=args.image_size, seed=args.seed)
+
+        def _online_train(seed=0):
+            for b in online:
+                yield to_trainer_batch(b)
+
+        loaded = {"train": _online_train}
+    else:
+        dataset = get_dataset(args.dataset, image_size=args.image_size,
+                              **({"root": args.dataset_path}
+                                 if args.dataset_path else {}))
+        loaded = get_dataset_grain(dataset, batch_size=args.batch_size,
+                                   image_size=args.image_size,
+                                   worker_count=args.grain_workers,
+                                   seed=args.seed)
 
     # model
     model_kwargs = json.loads(args.model_config)
@@ -159,6 +192,13 @@ def main(argv=None):
         null_cond = {"text": jnp.asarray(
             conditions[0].get_unconditional())}
 
+    # fp16 gets a loss-scaling policy (DynamicScale constructed by the
+    # trainer); bf16/f32 compute needs none.
+    policy = None
+    if args.dtype == "float16":
+        from flaxdiff_tpu.typing import Policy
+        policy = Policy(compute_dtype=jnp.float16)
+
     ckpt = Checkpointer(args.checkpoint_dir)
     trainer = DiffusionTrainer(
         apply_fn=apply_fn, init_fn=init_fn, tx=tx, schedule=schedule,
@@ -166,7 +206,7 @@ def main(argv=None):
         config=TrainerConfig(ema_decay=args.ema_decay,
                              uncond_prob=args.uncond_prob,
                              log_every=args.log_every, seed=args.seed),
-        null_cond=null_cond, checkpointer=ckpt)
+        policy=policy, null_cond=null_cond, checkpointer=ckpt)
 
     if ckpt.latest_step() is not None:
         step = trainer.restore_checkpoint()
@@ -225,11 +265,11 @@ def main(argv=None):
                 encoder(text))
         return batch
 
-    def data():
-        while True:
-            yield encode_text(next(raw_iter))
-
-    it = data()
+    # Background-thread text encoding, 2 batches ahead: encode cost hides
+    # behind device compute (placement decision measured in
+    # scripts/bench_text_encode.py; SURVEY §7.3(4)).
+    from flaxdiff_tpu.data.prefetch import prefetch_map
+    it = prefetch_map(encode_text, raw_iter, depth=2)
     done = 0
     while done < args.total_steps:
         chunk = min(args.val_every or args.total_steps,
@@ -255,6 +295,40 @@ def main(argv=None):
                               Validator.to_uint8(result["samples"]),
                               step=done)
     logger.log({"final_loss": hist["final_loss"]}, step=done)
+
+    # registry: record the run + per-metric best across runs; push a
+    # wandb artifact when a run is live (reference
+    # general_diffusion_trainer.py:560-727). Process 0 only — every host
+    # sees the same final metrics and registry.json lives on a shared
+    # filesystem.
+    if jax.process_index() != 0:
+        logger.finish()
+        ckpt.wait_until_finished()
+        return hist
+    from flaxdiff_tpu.trainer import ModelRegistry
+    reg_path = args.registry or os.path.join(
+        os.path.dirname(os.path.abspath(args.checkpoint_dir)),
+        "registry.json")
+    registry = ModelRegistry(reg_path)
+    final_metrics = {"loss": hist["final_loss"]}
+    directions = {"loss": False}
+    if validator is not None:
+        for m in validator.metrics:
+            if m.name in validator.tracker.best:
+                final_metrics[m.name] = validator.tracker.best[m.name]
+                directions[m.name] = m.higher_is_better
+    run_name = args.run_name or os.path.basename(
+        os.path.normpath(args.checkpoint_dir))
+    became_best = registry.register_run(
+        run_name, checkpoint_dir=args.checkpoint_dir, step=done,
+        metrics=final_metrics, metric_directions=directions,
+        config={"architecture": args.architecture,
+                "schedule": args.schedule, "dataset": args.dataset})
+    registry.push_artifact(run_name, args.checkpoint_dir,
+                           project=args.wandb_project)
+    logger.log({f"registry/best_{k}": v for k, v in became_best.items()},
+               step=done)
+
     logger.finish()
     ckpt.wait_until_finished()
     print(f"done: {done} steps, final loss {hist['final_loss']:.4f}")
