@@ -53,9 +53,15 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     backend: "flash" (Pallas TPU kernel), "xla", "ring" (sequence-parallel
     ring attention over the active mesh's seq axis — self-attention only),
-    or "auto" (flash on TPU when shapes qualify, else xla).
+    "performer" (FAVOR+ linear attention, O(L) approximate), or "auto"
+    (flash on TPU when shapes qualify, else xla).
     """
     assert q.ndim == 4 and k.ndim == 4 and v.ndim == 4
+    if backend == "performer":
+        # softmax is implicit in the kernel estimator (always f32), so
+        # force_fp32_for_softmax has no meaning here; scale is honored.
+        from .linear_attention import favor_attention
+        return favor_attention(q, k, v, scale=scale)
     if backend == "ring":
         from ..parallel.context import (get_active_mesh, get_seq_axis,
                                         seq_parallel_active)

@@ -7,6 +7,7 @@ state sharded on the `fsdp` axis, batch on `data`), donated state, EMA as
 a sharded pytree update, CFG dropout by `jnp.where` null-embedding mask,
 and no per-step host sync (loss is read back only at the log cadence).
 """
+from .autoencoder_trainer import AutoEncoderTrainer, AutoEncoderTrainerConfig
 from .checkpoints import Checkpointer, abstract_state_like
 from .logging import JsonlLogger, MultiLogger, WandbLogger, make_logger, save_image_grid
 from .registry import ModelRegistry
@@ -31,4 +32,6 @@ __all__ = [
     "make_logger",
     "save_image_grid",
     "ModelRegistry",
+    "AutoEncoderTrainer",
+    "AutoEncoderTrainerConfig",
 ]

@@ -1,0 +1,155 @@
+"""VAE training loop: makes the first-party KL autoencoder trainable, so
+latent diffusion runs end-to-end on first-party latents.
+
+The reference shipped a broken attempt (reference
+trainer/autoencoder_trainer.py references undefined attributes, e.g.
+noise_schedule at :83, and is wired to no CLI); this is the working
+TPU-native equivalent: one jitted FSDP-sharded step computing
+reconstruction + beta * KL on the KLEncoder/KLDecoder pair, EMA, and a
+latent-scale measurement helper (the SD `scaling_factor` convention:
+1 / std of encoded latents).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.autoencoder import (KLAutoEncoder, gaussian_sample,
+                                  kl_divergence)
+from ..parallel import fsdp_sharding_tree, sharding_tree
+from ..parallel.mesh import batch_spec
+from ..typing import PyTree
+from ..utils import normalize_images
+from .train_state import TrainState
+
+
+@dataclasses.dataclass
+class AutoEncoderTrainerConfig:
+    kl_weight: float = 1e-6        # SD-style tiny KL
+    recon_loss: str = "l2"         # "l1" | "l2"
+    ema_decay: Optional[float] = 0.999
+    normalize: bool = True
+    log_every: int = 100
+    seed: int = 0
+
+
+class AutoEncoderTrainer:
+    """Trains a KLAutoEncoder's encoder+decoder jointly."""
+
+    def __init__(self, vae: KLAutoEncoder, tx: optax.GradientTransformation,
+                 mesh: Mesh,
+                 config: AutoEncoderTrainerConfig = AutoEncoderTrainerConfig()):
+        self.vae = vae
+        self.mesh = mesh
+        self.config = config
+
+        encoder, decoder = vae.encoder, vae.decoder
+
+        def loss_fn(params, x, key):
+            moments = encoder.apply({"params": params["encoder"]}, x)
+            z = gaussian_sample(moments, key)
+            recon = decoder.apply({"params": params["decoder"]}, z)
+            if config.recon_loss == "l1":
+                rec = jnp.mean(jnp.abs(recon - x))
+            else:
+                rec = jnp.mean((recon - x) ** 2)
+            kl = jnp.mean(kl_divergence(moments))
+            return rec + config.kl_weight * kl, (rec, kl)
+
+        def step_fn(state: TrainState, batch: PyTree):
+            key = jax.random.fold_in(state.rng, state.step)
+            x = batch["sample"]
+            x = normalize_images(x) if config.normalize \
+                else x.astype(jnp.float32)
+            (loss, (rec, kl)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params, x, key)
+            new_state = state.apply_gradients(grads)
+            if config.ema_decay is not None:
+                new_state = new_state.apply_ema(config.ema_decay)
+            return new_state, {"loss": loss, "recon": rec, "kl": kl}
+
+        def create_state(key):
+            return TrainState.create(
+                apply_fn=None, params=vae.params, tx=tx, rng=key,
+                ema_decay=config.ema_decay)
+
+        key = jax.random.PRNGKey(config.seed)
+        state_shapes = jax.eval_shape(create_state, key)
+        self.state_specs = fsdp_sharding_tree(state_shapes, mesh)
+        self.state_shardings = sharding_tree(self.state_specs, mesh)
+        with mesh:
+            self.state = jax.jit(
+                create_state, out_shardings=self.state_shardings)(key)
+
+        self._batch_axis = batch_spec(mesh)
+        self._step = jax.jit(step_fn, donate_argnums=(0,))
+
+    def put_batch(self, batch: PyTree) -> PyTree:
+        def put(x):
+            x = np.asarray(x)
+            ax = self._batch_axis[0] if len(self._batch_axis) else None
+            spec = P(*((ax,) + (None,) * (x.ndim - 1)))
+            return jax.make_array_from_process_local_data(
+                NamedSharding(self.mesh, spec), x)
+        return {"sample": put(batch["sample"])}
+
+    def train_step(self, batch: PyTree) -> Dict[str, jax.Array]:
+        self.state, metrics = self._step(self.state, batch)
+        return metrics
+
+    def fit(self, data: Iterator[PyTree], total_steps: int,
+            callbacks=()) -> Dict[str, Any]:
+        cfg = self.config
+        history: Dict[str, Any] = {"steps": [], "loss": [], "recon": [],
+                                   "kl": []}
+        metrics = None
+        t0 = time.perf_counter()
+        for i in range(total_steps):
+            metrics = self.train_step(self.put_batch(next(data)))
+            if (i + 1) % cfg.log_every == 0 or i == total_steps - 1:
+                vals = {k: float(v) for k, v in metrics.items()}
+                history["steps"].append(i + 1)
+                for k in ("loss", "recon", "kl"):
+                    history[k].append(vals[k])
+                for cb in callbacks:
+                    cb(i + 1, vals["loss"], vals)
+        history["final_loss"] = history["loss"][-1] if history["loss"] \
+            else float("nan")
+        history["seconds"] = time.perf_counter() - t0
+        return history
+
+    # -- export ---------------------------------------------------------------
+    def trained_vae(self, use_ema: bool = True,
+                    scaling_factor: Optional[float] = None) -> KLAutoEncoder:
+        """KLAutoEncoder bound to the trained params."""
+        params = (self.state.ema_params
+                  if use_ema and self.state.ema_params is not None
+                  else self.state.params)
+        params = jax.device_get(params)
+        cfg = self.vae.serialize()
+        if scaling_factor is not None:
+            cfg["scaling_factor"] = float(scaling_factor)
+        return KLAutoEncoder(params, **{k: v for k, v in cfg.items()
+                                        if k != "scaling_factor"},
+                             scaling_factor=cfg["scaling_factor"])
+
+    def measure_latent_scale(self, data: Iterator[PyTree],
+                             num_batches: int = 8) -> float:
+        """SD convention: scaling_factor = 1 / std(encoder latents), so
+        scaled latents are ~unit variance for the diffusion prior."""
+        stds = []
+        vae = self.trained_vae(use_ema=False, scaling_factor=1.0)
+        for _ in range(num_batches):
+            x = jnp.asarray(next(data)["sample"])
+            x = (normalize_images(x) if self.config.normalize
+                 else x.astype(jnp.float32))
+            z = vae.encode(x)
+            stds.append(float(jnp.std(z)))
+        return 1.0 / max(float(np.mean(stds)), 1e-6)

@@ -56,7 +56,6 @@ class ModelRegistry:
         reference's is-this-the-best-run comparison
         (general_diffusion_trainer.py:596-703), direction-aware via
         `metric_directions` ({name: higher_is_better}, default lower)."""
-        directions = metric_directions or {}
         run = self._data["runs"].setdefault(name, {})
         run.update({
             "checkpoint_dir": checkpoint_dir,
@@ -67,28 +66,41 @@ class ModelRegistry:
         if config is not None:
             run["config"] = config
 
-        became_best: Dict[str, bool] = {}
-        for metric, value in metrics.items():
-            hib = bool(directions.get(metric, False))
-            cur = self._data["best"].get(metric)
-            better = (cur is None
-                      or (value > cur["value"] if hib
-                          else value < cur["value"]))
-            became_best[metric] = bool(better)
-            if better:
-                self._data["best"][metric] = {
-                    "run": name, "value": float(value),
-                    "higher_is_better": hib,
-                    "checkpoint_dir": checkpoint_dir, "step": int(step),
-                }
+        # persist directions, then RECOMPUTE best from all runs' current
+        # metrics — a run re-registering with a worse value must not keep
+        # holding "best" with a stale value whose checkpoint has rotated
+        # away (max_to_keep).
+        dirs = self._data.setdefault("directions", {})
+        for metric, hib in (metric_directions or {}).items():
+            dirs[metric] = bool(hib)
+        self._recompute_best()
+        became_best = {m: self._data["best"].get(m, {}).get("run") == name
+                       for m in metrics}
         self._save()
         return became_best
 
-    def push_artifact(self, name: str, checkpoint_dir: str,
-                      project: Optional[str] = None) -> bool:
+    def _recompute_best(self):
+        dirs = self._data.get("directions", {})
+        best: Dict[str, Any] = {}
+        for name, run in self._data["runs"].items():
+            for metric, value in run.get("metrics", {}).items():
+                hib = bool(dirs.get(metric, False))
+                cur = best.get(metric)
+                if (cur is None or (value > cur["value"] if hib
+                                    else value < cur["value"])):
+                    best[metric] = {
+                        "run": name, "value": float(value),
+                        "higher_is_better": hib,
+                        "checkpoint_dir": run["checkpoint_dir"],
+                        "step": int(run["step"]),
+                    }
+        self._data["best"] = best
+
+    def push_artifact(self, name: str, checkpoint_dir: str) -> bool:
         """Upload the checkpoint directory as a wandb artifact when wandb
         is importable and a run is active (reference
-        general_diffusion_trainer.py:560-594); returns False offline."""
+        general_diffusion_trainer.py:560-594) — the artifact lands in the
+        active run's project; returns False offline."""
         try:
             import wandb
             if wandb.run is None:

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Pack an image folder (or HuggingFace dataset) into packed-record shards
+readable by the native C++ reader (flaxdiff_tpu/native/packed_reader.cpp).
+
+The offline equivalent of the reference's dataset tooling
+(reference datasets/data-processing.py + img2dataset shell scripts,
+dataset_map.py ArrayRecord shards): images are JPEG-encoded with captions
+into the framework's own record format, sharded for parallel reads.
+
+Usage:
+  python scripts/pack_dataset.py --src ./images_dir --out ./shards \
+      --shards 4 --image_size 256
+  python scripts/pack_dataset.py --src hf:nelorth/oxford-flowers \
+      --out ./shards --caption_key label
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flaxdiff_tpu.data.packed_records import PackedRecordWriter  # noqa: E402
+
+IMAGE_EXTS = (".jpg", ".jpeg", ".png", ".webp", ".bmp")
+
+
+def _rgb_to_bgr(img: np.ndarray) -> np.ndarray:
+    """RGB/grayscale/RGBA -> 3-channel BGR for cv2.imencode (a bare
+    [..., ::-1] would mirror 2-D grayscale and scramble RGBA)."""
+    import cv2
+    if img.ndim == 2:
+        return cv2.cvtColor(img, cv2.COLOR_GRAY2BGR)
+    if img.shape[2] == 4:
+        return cv2.cvtColor(img, cv2.COLOR_RGBA2BGR)
+    return np.ascontiguousarray(img[..., ::-1])
+
+
+def iter_folder(src: str, caption_from_name: bool):
+    import cv2
+    for dirpath, _dirs, files in os.walk(src):
+        for f in sorted(files):
+            if not f.lower().endswith(IMAGE_EXTS):
+                continue
+            path = os.path.join(dirpath, f)
+            img = cv2.imread(path)
+            if img is None:
+                continue
+            caption = ""
+            if caption_from_name:
+                # folder-name captioning (class-per-directory layout)
+                caption = os.path.basename(dirpath).replace("_", " ")
+            txt = os.path.splitext(path)[0] + ".txt"
+            if os.path.exists(txt):
+                caption = open(txt).read().strip()
+            yield img[..., ::-1], caption  # BGR -> RGB
+
+
+def iter_hf(name: str, image_key: str, caption_key: str):
+    import datasets
+    ds = datasets.load_dataset(name, split="train")
+    for row in ds:
+        img = np.asarray(row[image_key])
+        caption = str(row.get(caption_key, ""))
+        yield img, caption
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--src", required=True,
+                    help="image folder, or hf:<dataset-name>")
+    ap.add_argument("--out", required=True, help="output shard directory")
+    ap.add_argument("--shards", type=int, default=4)
+    ap.add_argument("--image_size", type=int, default=0,
+                    help="resize shorter side to this (0 = keep)")
+    ap.add_argument("--quality", type=int, default=92)
+    ap.add_argument("--image_key", default="image")
+    ap.add_argument("--caption_key", default="text")
+    ap.add_argument("--caption_from_dirname", action="store_true")
+    args = ap.parse_args()
+
+    import cv2
+    os.makedirs(args.out, exist_ok=True)
+    if args.src.startswith("hf:"):
+        it = iter_hf(args.src[3:], args.image_key, args.caption_key)
+    else:
+        it = iter_folder(args.src, args.caption_from_dirname)
+
+    writers = [PackedRecordWriter(
+        os.path.join(args.out, f"shard-{i:05d}.pack"))
+        for i in range(args.shards)]
+    counts = [0] * args.shards
+    n = 0
+    for img, caption in it:
+        if args.image_size:
+            h, w = img.shape[:2]
+            s = args.image_size / min(h, w)
+            img = cv2.resize(img, (round(w * s), round(h * s)),
+                             interpolation=cv2.INTER_AREA)
+        ok, enc = cv2.imencode(".jpg", _rgb_to_bgr(img),
+                               [cv2.IMWRITE_JPEG_QUALITY, args.quality])
+        if not ok:
+            continue
+        shard = n % args.shards
+        writers[shard].write({"jpg": enc.tobytes(),
+                              "txt": caption.encode("utf-8")})
+        counts[shard] += 1
+        n += 1
+        if n % 1000 == 0:
+            print(f"packed {n}...", file=sys.stderr)
+    for w in writers:
+        w.close()
+    meta = {"total": n, "shards": args.shards, "counts": counts,
+            "image_size": args.image_size}
+    with open(os.path.join(args.out, "meta.json"), "w") as fh:
+        json.dump(meta, fh)
+    print(json.dumps(meta))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+"""FAVOR+ linear attention tests (flaxdiff_tpu/ops/linear_attention.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flaxdiff_tpu.ops.attention import dot_product_attention
+from flaxdiff_tpu.ops.linear_attention import (favor_attention,
+                                               orthogonal_random_features,
+                                               softmax_kernel_features)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def _softmax_attention(q, k, v, causal=False):
+    d = q.shape[-1]
+    s = jnp.einsum("blhd,bmhd->bhlm", q, k) / jnp.sqrt(d)
+    if causal:
+        L = q.shape[1]
+        mask = jnp.tril(jnp.ones((L, L), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhlm,bmhd->blhd", p, v)
+
+
+def test_orthogonal_features_are_orthogonal():
+    proj = orthogonal_random_features(jax.random.PRNGKey(0), 32, 16)
+    assert proj.shape == (32, 16)
+    # rows within each d-block are mutually orthogonal
+    block = proj[:16]
+    normalized = block / jnp.linalg.norm(block, axis=1, keepdims=True)
+    gram = np.asarray(normalized @ normalized.T)
+    np.testing.assert_allclose(gram, np.eye(16), atol=1e-5)
+
+
+def test_kernel_feature_expectation(rng):
+    """E[phi(q).phi(k)] ~= exp(q.k) — the softmax-kernel estimator."""
+    d, m = 8, 4096
+    proj = orthogonal_random_features(jax.random.PRNGKey(1), m, d)
+    q = jnp.asarray(rng.normal(size=(1, 1, 1, d)) * 0.5, jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 1, 1, d)) * 0.5, jnp.float32)
+    qf = softmax_kernel_features(q, proj, True)
+    # featurize both keys in ONE call so they share the global key
+    # stabilizer (it cancels in the ratio); attention normalizes the
+    # same way, which is why per-call stabilizers are sound there
+    k2 = jnp.asarray(rng.normal(size=(1, 1, 1, d)) * 0.5, jnp.float32)
+    both = jnp.concatenate([k, k2], axis=1)          # [1, 2, 1, d]
+    kf_both = softmax_kernel_features(both, proj, False)
+    est = float(jnp.sum(qf[:, 0] * kf_both[:, 0]) * m)
+    est2 = float(jnp.sum(qf[:, 0] * kf_both[:, 1]) * m)
+    true_ratio = float(jnp.exp(jnp.sum(q * k) - jnp.sum(q * k2)))
+    assert est2 > 0
+    np.testing.assert_allclose(est / est2, true_ratio, rtol=0.35)
+
+
+def test_favor_approximates_softmax_attention(rng):
+    b, l, h, d = 2, 32, 2, 16
+    q = jnp.asarray(rng.normal(size=(b, l, h, d)) * 0.3, jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, l, h, d)) * 0.3, jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, l, h, d)), jnp.float32)
+    want = np.asarray(_softmax_attention(q, k, v))
+    got = np.asarray(favor_attention(q, k, v, n_features=1024))
+    # random-feature estimator: close in relative L2, not elementwise
+    rel = np.linalg.norm(got - want) / np.linalg.norm(want)
+    assert rel < 0.25, f"relative error {rel}"
+    # more features -> better approximation (variance shrinks)
+    coarse = np.asarray(favor_attention(q, k, v, n_features=64, seed=2))
+    rel_coarse = np.linalg.norm(coarse - want) / np.linalg.norm(want)
+    assert rel < rel_coarse
+
+
+def test_favor_causal_matches_masked_softmax(rng):
+    b, l, h, d = 1, 24, 2, 16
+    q = jnp.asarray(rng.normal(size=(b, l, h, d)) * 0.3, jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, l, h, d)) * 0.3, jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, l, h, d)), jnp.float32)
+    want = np.asarray(_softmax_attention(q, k, v, causal=True))
+    got = np.asarray(favor_attention(q, k, v, n_features=1024, causal=True))
+    rel = np.linalg.norm(got - want) / np.linalg.norm(want)
+    assert rel < 0.3, f"relative error {rel}"
+    # the first position attends only to itself -> exact (ratio cancels)
+    np.testing.assert_allclose(got[:, 0], np.asarray(v)[:, 0], rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_performer_backend_dispatch(rng):
+    q = jnp.asarray(rng.normal(size=(1, 16, 2, 8)) * 0.3, jnp.float32)
+    out = dot_product_attention(q, q, q, backend="performer")
+    assert out.shape == q.shape
+    assert np.all(np.isfinite(np.asarray(out)))
+    # deterministic (cached projection)
+    out2 = dot_product_attention(q, q, q, backend="performer")
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+def test_favor_differentiable(rng):
+    q = jnp.asarray(rng.normal(size=(1, 8, 1, 8)) * 0.3, jnp.float32)
+
+    def loss(q):
+        return jnp.sum(favor_attention(q, q, q, n_features=64) ** 2)
+
+    g = jax.grad(loss)(q)
+    assert np.all(np.isfinite(np.asarray(g)))
+    assert float(jnp.abs(g).sum()) > 0

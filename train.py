@@ -22,6 +22,8 @@ def parse_args(argv=None):
     p.add_argument("--dataset", default="synthetic",
                    help="name in DATASET_REGISTRY")
     p.add_argument("--dataset_path", default=None)
+    p.add_argument("--hf_text_key", default="text",
+                   help="caption column for online:<hf-dataset> streaming")
     p.add_argument("--image_size", type=int, default=64)
     p.add_argument("--batch_size", type=int, default=64)
     p.add_argument("--grain_workers", type=int, default=0)
@@ -140,7 +142,8 @@ def main(argv=None):
                 image_size=args.image_size, seed=args.seed)
         else:
             online = OnlineStreamingDataLoader.from_hf_dataset(
-                name, batch_size=args.batch_size,
+                name, text_key=args.hf_text_key,
+                batch_size=args.batch_size,
                 image_size=args.image_size, seed=args.seed)
 
         def _online_train(seed=0):
@@ -324,8 +327,7 @@ def main(argv=None):
         metrics=final_metrics, metric_directions=directions,
         config={"architecture": args.architecture,
                 "schedule": args.schedule, "dataset": args.dataset})
-    registry.push_artifact(run_name, args.checkpoint_dir,
-                           project=args.wandb_project)
+    registry.push_artifact(run_name, args.checkpoint_dir)
     logger.log({f"registry/best_{k}": v for k, v in became_best.items()},
                step=done)
 
