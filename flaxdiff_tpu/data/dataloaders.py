@@ -87,6 +87,7 @@ class GrainLoader:
         epoch = 0
         while True:
             it = iter(self.make_loader(seed + epoch))
+            produced = 0
             while True:
                 try:
                     batch = to_trainer_batch(_destring(next(it)))
@@ -99,7 +100,15 @@ class GrainLoader:
                         continue
                     batch = fallback_batch(last_good)
                 last_good = batch
+                produced += 1
                 yield batch
+            if produced == 0 and last_good is None:
+                # fewer records than one (drop_remainder) batch: an
+                # epoch yields nothing and the loop would spin forever
+                raise ValueError(
+                    "grain epoch produced no batches — dataset smaller "
+                    "than one batch (drop_remainder)? records per "
+                    f"process insufficient for the local batch size")
             epoch += 1
 
 

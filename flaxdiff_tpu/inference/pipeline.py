@@ -72,6 +72,34 @@ class DiffusionInferencePipeline:
             config=config)
 
     @staticmethod
+    def from_registry(registry_path: str, metric: str = "loss",
+                      autoencoder=None) -> "DiffusionInferencePipeline":
+        """Load the best run for `metric` from a ModelRegistry
+        (reference pipeline.py:103-147 from_wandb_registry, over the local
+        registry.json instead of the wandb model registry)."""
+        from ..trainer.registry import ModelRegistry
+        best = ModelRegistry(registry_path).best_run(metric)
+        if best is None:
+            raise FileNotFoundError(
+                f"registry {registry_path} has no best run for "
+                f"metric {metric!r}")
+        # the registry records the STEP that achieved the best value;
+        # load it if it is still on disk (max_to_keep rotates old steps)
+        from ..trainer.checkpoints import Checkpointer
+        ck = Checkpointer(best["checkpoint_dir"])
+        steps = ck.all_steps()
+        ck.close()
+        step = best.get("step") if best.get("step") in steps else None
+        if step is None and best.get("step") is not None:
+            import warnings
+            warnings.warn(
+                f"registry best step {best['step']} no longer on disk "
+                f"under {best['checkpoint_dir']}; loading latest",
+                stacklevel=2)
+        return DiffusionInferencePipeline.from_checkpoint(
+            best["checkpoint_dir"], step=step, autoencoder=autoencoder)
+
+    @staticmethod
     def from_checkpoint(checkpoint_dir: str,
                         step: Optional[int] = None,
                         autoencoder=None) -> "DiffusionInferencePipeline":

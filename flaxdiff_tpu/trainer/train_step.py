@@ -78,9 +78,18 @@ def make_train_step(
         if cond is not None and null_cond is not None and config.uncond_prob > 0:
             uncond_mask = jax.random.bernoulli(
                 uncond_key, config.uncond_prob, (x0.shape[0],))
-            cond = jax.tree_util.tree_map(
-                lambda c, u: cfg_uncond_splice(c, u, uncond_mask),
-                cond, null_cond)
+            if isinstance(cond, dict) and isinstance(null_cond, dict):
+                # splice per intersecting key: a null_cond prepared for
+                # more modalities than this batch carries (e.g. text null
+                # with an audio-only AV batch) must not be a structural
+                # error — unmatched conditions pass through undropped.
+                cond = {k: (cfg_uncond_splice(c, null_cond[k], uncond_mask)
+                            if k in null_cond else c)
+                        for k, c in cond.items()}
+            else:
+                cond = jax.tree_util.tree_map(
+                    lambda c, u: cfg_uncond_splice(c, u, uncond_mask),
+                    cond, null_cond)
 
         B = x0.shape[0]
         t = schedule.sample_timesteps(t_key, B)

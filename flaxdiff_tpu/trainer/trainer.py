@@ -226,10 +226,19 @@ class DiffusionTrainer:
         history: Dict[str, Any] = {"steps": [], "loss": [], "imgs_per_sec": [],
                                    "mfu": []}
 
+        # one-deep device double buffering: while the device runs step N
+        # (dispatch is async), the host fetches and uploads batch N+1 —
+        # the H2D copy hides behind compute instead of serializing with
+        # it (the reference pays this copy on the critical path every
+        # step, simple_trainer.py:530-533).
+        batch = next(data)
+        global_batch = self.put_batch(batch)
         for i in range(total_steps):
-            batch = next(data)
-            global_batch = self.put_batch(batch)
-            pending_loss = self.train_step(global_batch)
+            current = global_batch
+            pending_loss = self.train_step(current)
+            if i + 1 < total_steps:
+                batch = next(data)
+                global_batch = self.put_batch(batch)
             steps_in_window += 1
 
             if (i + 1) % cfg.log_every == 0 or i == total_steps - 1:

@@ -26,11 +26,15 @@ non-trivial diffusion model.
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
 
-from flaxdiff_tpu.data.prefetch import prefetch_map
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flaxdiff_tpu.data.prefetch import prefetch_map  # noqa: E402
 
 BATCHES = 40
 

@@ -45,3 +45,28 @@ def mesh(devices):
 @pytest.fixture()
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def make_av_file():
+    """Factory: synthesize a cv2 mp4 + sidecar sine wav (the av module's
+    no-ffmpeg path). Shared by the AV pipeline and CLI video tests."""
+    def _make(path, size=64, dur=3, fps=25, tone=440, sidecar_sr=22050):
+        import cv2
+        from scipy.io import wavfile
+        path = str(path)
+        w = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"), fps,
+                            (size, size))
+        assert w.isOpened()
+        r = np.random.default_rng(0)
+        for i in range(int(dur * fps)):
+            frame = np.full((size, size, 3), (i * 7) % 255, np.uint8)
+            frame[: size // 4] = r.integers(0, 255, (size // 4, size, 3),
+                                            dtype=np.uint8)
+            w.write(frame)
+        w.release()
+        t = np.arange(int(dur * sidecar_sr), dtype=np.float32) / sidecar_sr
+        audio = (0.5 * np.sin(2 * np.pi * tone * t) * 32767).astype(np.int16)
+        wavfile.write(path.rsplit(".", 1)[0] + ".wav", sidecar_sr, audio)
+        return path
+    return _make

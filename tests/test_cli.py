@@ -38,3 +38,24 @@ def test_cli_trains_via_online_path(tmp_path):
 def test_cli_rejects_unknown_val_metric(tmp_path):
     with pytest.raises(SystemExit, match="unknown --val_metrics"):
         _run(tmp_path, "--val_every", "2", "--val_metrics", "nope")
+
+
+def test_cli_trains_video_with_audio_conditioning(tmp_path, make_av_file):
+    """Video+audio end-to-end through the CLI: av_folder dataset ->
+    MelAudioEncoder tokens -> UNet3D train steps."""
+    vids = tmp_path / "vids"
+    vids.mkdir()
+    for i in range(8):   # >= one full batch after drop_remainder
+        make_av_file(vids / f"{i}.mp4", size=32, dur=2)
+    hist = _run(
+        tmp_path, "--dataset", "av_folder",
+        "--dataset_path", str(vids),
+        "--architecture", "unet_3d",
+        "--model_config", json.dumps({
+            "feature_depths": [8], "attention_levels": [True],
+            "emb_features": 16, "num_res_blocks": 1, "norm_groups": 4,
+            "heads": 2}),
+        "--num_frames", "4", "--audio_encoder", "mel",
+        "--text_encoder", "none", "--batch_size", "8",
+        "--log_every", "1")
+    assert np.isfinite(hist["final_loss"])

@@ -96,3 +96,37 @@ def test_cli_end_to_end(tmp_path):
                                 use_ema=True)
     assert out.shape == (2, 8, 8, 3)
     assert np.all(np.isfinite(out))
+
+
+def test_pipeline_from_registry(tmp_path):
+    """Registry -> best checkpoint -> pipeline (reference
+    from_wandb_registry equivalent)."""
+    import json
+
+    from flaxdiff_tpu.trainer import ModelRegistry
+
+    # reuse the CLI to produce a real checkpoint + config
+    import train
+    ckpt_dir = tmp_path / "runs" / "regrun"
+    train.main([
+        "--dataset", "synthetic", "--image_size", "16",
+        "--batch_size", "16", "--architecture", "unet",
+        "--model_config", json.dumps({
+            "feature_depths": [8, 16], "attention_configs": [None, None],
+            "emb_features": 16, "num_res_blocks": 1}),
+        "--total_steps", "4", "--log_every", "2", "--warmup_steps", "2",
+        "--save_every", "2", "--text_encoder", "none",
+        "--checkpoint_dir", str(ckpt_dir), "--run_name", "regrun"])
+
+    reg_path = str(tmp_path / "runs" / "registry.json")
+    assert ModelRegistry(reg_path).best_run("loss")["run"] == "regrun"
+
+    from flaxdiff_tpu.inference import DiffusionInferencePipeline
+    pipe = DiffusionInferencePipeline.from_registry(reg_path, metric="loss")
+    out = pipe.generate_samples(num_samples=2, resolution=16,
+                                diffusion_steps=2, sampler="ddim")
+    assert out.shape == (2, 16, 16, 3)
+
+    import pytest
+    with pytest.raises(FileNotFoundError, match="no best run"):
+        DiffusionInferencePipeline.from_registry(reg_path, metric="fid")

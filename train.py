@@ -25,6 +25,11 @@ def parse_args(argv=None):
     p.add_argument("--hf_text_key", default="text",
                    help="caption column for online:<hf-dataset> streaming")
     p.add_argument("--image_size", type=int, default=64)
+    p.add_argument("--num_frames", type=int, default=0,
+                   help=">0 trains a video model on [B,F,H,W,C] clips")
+    p.add_argument("--audio_encoder", default="none",
+                   choices=["none", "mel"],
+                   help="condition video models on clip audio")
     p.add_argument("--batch_size", type=int, default=64)
     p.add_argument("--grain_workers", type=int, default=0)
     # model
@@ -152,9 +157,11 @@ def main(argv=None):
 
         loaded = {"train": _online_train}
     else:
+        ds_kwargs = {"root": args.dataset_path} if args.dataset_path else {}
+        if args.num_frames:
+            ds_kwargs["num_frames"] = args.num_frames
         dataset = get_dataset(args.dataset, image_size=args.image_size,
-                              **({"root": args.dataset_path}
-                                 if args.dataset_path else {}))
+                              **ds_kwargs)
         loaded = get_dataset_grain(dataset, batch_size=args.batch_size,
                                    image_size=args.image_size,
                                    worker_count=args.grain_workers,
@@ -168,17 +175,31 @@ def main(argv=None):
     schedule = get_schedule(args.schedule, timesteps=args.timesteps)
     transform = get_transform(args.predictor)
 
+    # audio conditioning for video models (one token per frame)
+    audio_enc = None
+    if args.audio_encoder == "mel":
+        from flaxdiff_tpu.inputs import MelAudioEncoder
+        audio_enc = MelAudioEncoder.create()
+
     ctx_shape = None
     if encoder is not None:
         ctx_shape = tuple(conditions[0].get_unconditional()[0].shape)
+    elif audio_enc is not None and args.num_frames:
+        ctx_shape = (args.num_frames, audio_enc.features)
 
-    x0 = jnp.zeros((2, args.image_size, args.image_size, 3))
+    if args.num_frames:
+        x0 = jnp.zeros((2, args.num_frames, args.image_size,
+                        args.image_size, 3))
+    else:
+        x0 = jnp.zeros((2, args.image_size, args.image_size, 3))
     t0 = jnp.zeros((2,))
     c0 = (jnp.zeros((2,) + ctx_shape) if ctx_shape else None)
 
     def apply_fn(params, x, t, cond):
-        text = cond["text"] if (cond is not None and "text" in cond) else None
-        return model.apply(params, x, t, text)
+        ctx = None
+        if cond is not None:
+            ctx = cond.get("text", cond.get("audio"))
+        return model.apply(params, x, t, ctx)
 
     def init_fn(key):
         return model.init(key, x0, t0, c0)
@@ -190,10 +211,13 @@ def main(argv=None):
            "lamb": optax.lamb}[args.optimizer]
     tx = optax.chain(optax.clip_by_global_norm(args.grad_clip), opt(lr))
 
-    null_cond = None
+    null_cond = {}
     if encoder is not None:
-        null_cond = {"text": jnp.asarray(
-            conditions[0].get_unconditional())}
+        null_cond["text"] = jnp.asarray(conditions[0].get_unconditional())
+    if audio_enc is not None and args.num_frames:
+        null_cond["audio"] = jnp.zeros(
+            (1, args.num_frames, audio_enc.features))
+    null_cond = null_cond or None
 
     # fp16 gets a loss-scaling policy (DynamicScale constructed by the
     # trainer); bf16/f32 compute needs none.
@@ -251,22 +275,28 @@ def main(argv=None):
                 num_samples=args.val_samples,
                 diffusion_steps=args.val_steps,
                 guidance_scale=args.val_guidance if encoder else 0.0,
-                resolution=args.image_size))
+                resolution=args.image_size,
+                sequence_length=args.num_frames or None))
 
     raw_iter = loaded["train"](seed=args.seed)
 
     def encode_text(batch):
-        """Host-side text encoding: raw caption strings -> embeddings.
-        Raw strings stay in the batch (put_batch strips non-numerics
-        before jit) so validation metrics that need prompts — CLIPScore —
-        still see batch['text']."""
-        if encoder is None or "text" not in batch:
-            return batch
-        text = batch["text"]
-        if isinstance(text, list):
+        """Host-side conditioning encode: captions -> text embeddings,
+        clip audio -> per-frame audio tokens. Raw strings stay in the
+        batch (put_batch strips non-numerics before jit) so validation
+        metrics that need prompts — CLIPScore — still see batch['text']."""
+        if encoder is not None and isinstance(batch.get("text"), list):
             batch.setdefault("cond", {})["text"] = np.asarray(
-                encoder(text))
-        return batch
+                encoder(batch["text"]))
+        if audio_enc is not None and isinstance(batch.get("audio"), dict):
+            fw = batch["audio"].get("framewise_audio")
+            if fw is not None:
+                batch.setdefault("cond", {})["audio"] = np.asarray(
+                    audio_enc(fw))
+        # keep only what the step consumes — raw audio waveforms / mel /
+        # mask side-channels would otherwise ride the H2D copy every step
+        return {k: v for k, v in batch.items()
+                if k in ("sample", "cond", "text")}
 
     # Background-thread text encoding, 2 batches ahead: encode cost hides
     # behind device compute (placement decision measured in
