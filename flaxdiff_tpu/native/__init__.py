@@ -14,7 +14,9 @@ from typing import Optional
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "packed_reader.cpp")
-_LIB = os.path.join(_HERE, "_packed_reader.so")
+# the artifact lives in a non-package subdir: a .so directly inside the
+# package looks like a CPython extension module to pkgutil/import tooling
+_LIB = os.path.join(_HERE, "_build", "packed_reader.so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 
@@ -23,6 +25,7 @@ def _build() -> str:
     # Compile to a process-unique temp path and rename atomically: several
     # processes (e.g. grain workers) may race the first build, and a
     # half-written .so must never be dlopen-able.
+    os.makedirs(os.path.dirname(_LIB), exist_ok=True)
     tmp = f"{_LIB}.{os.getpid()}.tmp"
     cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
     proc = subprocess.run(cmd, capture_output=True, text=True)
