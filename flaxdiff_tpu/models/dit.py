@@ -93,6 +93,7 @@ class SimpleDiT(nn.Module):
     force_fp32_for_softmax: bool = True
     norm_epsilon: float = 1e-5
     learn_sigma: bool = False
+    remat: bool = False   # jax.checkpoint each DiTBlock (memory lever)
     use_hilbert: bool = False
     use_zigzag: bool = False
     activation: Callable = jax.nn.gelu   # MLP nonlinearity inside DiTBlocks
@@ -119,8 +120,11 @@ class SimpleDiT(nn.Module):
         freqs = scan_rope(self.emb_features // self.num_heads, num_patches,
                           scan_order)
 
+        # nn.remat = jax.checkpoint per block: recompute activations in
+        # the backward pass instead of holding depth x tokens in HBM
+        BlockCls = nn.remat(DiTBlock) if self.remat else DiTBlock
         for i in range(self.num_layers):
-            tokens = DiTBlock(
+            tokens = BlockCls(
                 features=self.emb_features, num_heads=self.num_heads,
                 mlp_ratio=self.mlp_ratio, backend=self.backend,
                 dtype=self.dtype, precision=self.precision,

@@ -156,6 +156,8 @@ class UNet3D(nn.Module):
     dtype: Optional[Dtype] = None
     precision: Optional[jax.lax.Precision] = None
     activation: Callable = jax.nn.swish
+    # jax.checkpoint each level block (num_frames is static arg 4)
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, temb: jax.Array,
@@ -178,10 +180,12 @@ class UNet3D(nn.Module):
         h = nn.Conv(self.feature_depths[0], (3, 3), padding="SAME",
                     dtype=self.dtype, name="conv_in")(xf)
 
+        BlockCls = (nn.remat(UNet3DBlock, static_argnums=(4,))
+                    if self.remat else UNet3DBlock)
         skips = [h]
         for i, feats in enumerate(self.feature_depths):
             for j in range(self.num_res_blocks):
-                h = UNet3DBlock(
+                h = BlockCls(
                     features=feats, heads=self.heads,
                     use_attention=self.attention_levels[i],
                     norm_groups=self.norm_groups, backend=self.backend,
@@ -203,7 +207,7 @@ class UNet3D(nn.Module):
             skips = [s + r for s, r in
                      zip(skips, down_block_additional_residuals)]
 
-        h = UNet3DBlock(features=self.feature_depths[-1], heads=self.heads,
+        h = BlockCls(features=self.feature_depths[-1], heads=self.heads,
                         use_attention=True, norm_groups=self.norm_groups,
                         backend=self.backend, dtype=self.dtype,
                         precision=self.precision,
@@ -215,7 +219,7 @@ class UNet3D(nn.Module):
             level = len(self.feature_depths) - 1 - i
             for j in range(self.num_res_blocks + 1):
                 h = jnp.concatenate([h, skips.pop()], axis=-1)
-                h = UNet3DBlock(
+                h = BlockCls(
                     features=feats, heads=self.heads,
                     use_attention=self.attention_levels[level],
                     norm_groups=self.norm_groups, backend=self.backend,
