@@ -137,12 +137,9 @@ class Unet(nn.Module):
                       dtype=self.dtype, precision=self.precision,
                       kernel_init=self.kernel_init, name="conv_mid_out")(x)
         x = jnp.concatenate([x, first_skip], axis=-1)
-        x = ResidualBlock(conv_type=self.conv_type,
-                          features=self.feature_depths[0],
-                          norm_groups=self.norm_groups,
-                          activation=self.activation, dtype=self.dtype,
-                          precision=self.precision,
-                          kernel_init=self.kernel_init, name="final_res")(x, temb)
+        # via the shared helper so remat also checkpoints this block —
+        # it runs at full input resolution, the largest activations
+        x = resblock(self.feature_depths[0], "final_res")(x, temb)
         x = nn.GroupNorm(self.norm_groups, dtype=jnp.float32,
                          name="final_norm")(x)
         x = self.activation(x)
