@@ -7,7 +7,7 @@ The online HTTP streaming loader mirrors reference data/online_loader.py
 with an injectable fetcher so it is testable offline.
 """
 from .dataloaders import get_dataset_grain, make_batch_iterator
-from .dataset_map import DATASET_REGISTRY, register_dataset
+from .dataset_map import DATASET_REGISTRY, get_dataset, register_dataset
 from .online_loader import OnlineStreamingDataLoader
 from .sources.base import DataAugmenter, DataSource, MediaDataset
 from .sources.images import (
@@ -30,5 +30,6 @@ __all__ = [
     "make_batch_iterator",
     "OnlineStreamingDataLoader",
     "DATASET_REGISTRY",
+    "get_dataset",
     "register_dataset",
 ]

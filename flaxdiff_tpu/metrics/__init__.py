@@ -7,6 +7,7 @@ from .clip_metrics import (
 )
 from .common import EvaluationMetric, MetricTracker
 from .fid import FeatureStats, FIDComputer, frechet_distance, get_fid_metric
+from .image_quality import get_psnr_metric, get_ssim_metric, psnr, ssim
 from .inception import (InceptionV3Features, convert_torch_state_dict,
                         load_inception_params, make_inception_extractor)
 
@@ -25,4 +26,8 @@ __all__ = [
     "clip_score",
     "get_clip_metric",
     "get_clip_score_metric",
+    "psnr",
+    "ssim",
+    "get_psnr_metric",
+    "get_ssim_metric",
 ]

@@ -1,0 +1,118 @@
+"""PSNR and SSIM image-quality metrics.
+
+The reference ships empty placeholder files for these
+(reference flaxdiff/metrics/psnr.py and ssim.py are both 0 LoC,
+SURVEY §2 "psnr.py/ssim.py/__init__.py are empty") — this module
+implements them for real. Both are pure jittable functions over
+batched NHWC (or video [B,T,H,W,C], flattened over frames) arrays in
+[-1, 1], plus `EvaluationMetric` factories that score generated
+samples against the paired `batch["sample"]` images — meaningful for
+reconstruction-style evaluation (VAE validation, img2img), not for
+unpaired generative sampling (use FID/CLIP there).
+
+SSIM follows Wang et al. 2004: 11x11 Gaussian window (sigma 1.5),
+K1=0.01, K2=0.03, per-channel, mean-pooled. Implemented with two 1-D
+depthwise convolutions (separable Gaussian) so XLA maps it onto conv
+units instead of an O(window²) dense filter.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common import EvaluationMetric
+
+_DATA_RANGE = 2.0  # images live in [-1, 1]
+
+
+def _flatten_video(x: jnp.ndarray) -> jnp.ndarray:
+    """[B,T,H,W,C] -> [B*T,H,W,C]; NHWC passes through."""
+    if x.ndim == 5:
+        return x.reshape((-1,) + x.shape[2:])
+    return x
+
+
+@jax.jit
+def psnr(pred: jnp.ndarray, target: jnp.ndarray,
+         data_range: float = _DATA_RANGE) -> jnp.ndarray:
+    """Mean peak signal-to-noise ratio (dB) over the batch."""
+    pred = _flatten_video(pred).astype(jnp.float32)
+    target = _flatten_video(target).astype(jnp.float32)
+    mse = jnp.mean((pred - target) ** 2, axis=(1, 2, 3))
+    mse = jnp.maximum(mse, 1e-12)
+    return jnp.mean(20.0 * jnp.log10(data_range) - 10.0 * jnp.log10(mse))
+
+
+def _gaussian_kernel(size: int, sigma: float) -> np.ndarray:
+    x = np.arange(size, dtype=np.float64) - (size - 1) / 2.0
+    k = np.exp(-0.5 * (x / sigma) ** 2)
+    return (k / k.sum()).astype(np.float32)
+
+
+def _blur(x: jnp.ndarray, kernel: jnp.ndarray) -> jnp.ndarray:
+    """Separable Gaussian blur, depthwise, VALID padding. x: [N,H,W,C]."""
+    c = x.shape[-1]
+    kh = jnp.tile(kernel.reshape(-1, 1, 1, 1), (1, 1, 1, c))
+    kw = jnp.tile(kernel.reshape(1, -1, 1, 1), (1, 1, 1, c))
+    dn = jax.lax.conv_dimension_numbers(x.shape, kh.shape,
+                                        ("NHWC", "HWIO", "NHWC"))
+    x = jax.lax.conv_general_dilated(x, kh, (1, 1), "VALID",
+                                     dimension_numbers=dn, feature_group_count=c)
+    x = jax.lax.conv_general_dilated(x, kw, (1, 1), "VALID",
+                                     dimension_numbers=dn, feature_group_count=c)
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("window_size", "sigma"))
+def ssim(pred: jnp.ndarray, target: jnp.ndarray,
+         data_range: float = _DATA_RANGE, window_size: int = 11,
+         sigma: float = 1.5) -> jnp.ndarray:
+    """Mean structural similarity over the batch (Wang et al. 2004)."""
+    pred = _flatten_video(pred).astype(jnp.float32)
+    target = _flatten_video(target).astype(jnp.float32)
+    if pred.shape[1] < window_size or pred.shape[2] < window_size:
+        raise ValueError(
+            f"images {pred.shape[1]}x{pred.shape[2]} smaller than the "
+            f"{window_size}x{window_size} SSIM window")
+    kernel = jnp.asarray(_gaussian_kernel(window_size, sigma))
+    c1 = (0.01 * data_range) ** 2
+    c2 = (0.03 * data_range) ** 2
+
+    mu_p = _blur(pred, kernel)
+    mu_t = _blur(target, kernel)
+    mu_pp, mu_tt, mu_pt = mu_p * mu_p, mu_t * mu_t, mu_p * mu_t
+    var_p = _blur(pred * pred, kernel) - mu_pp
+    var_t = _blur(target * target, kernel) - mu_tt
+    cov = _blur(pred * target, kernel) - mu_pt
+
+    s = ((2.0 * mu_pt + c1) * (2.0 * cov + c2)
+         / ((mu_pp + mu_tt + c1) * (var_p + var_t + c2)))
+    return jnp.mean(s)
+
+
+def _paired_target(batch: Optional[dict], n: int) -> np.ndarray:
+    if not batch or "sample" not in batch:
+        raise ValueError("psnr/ssim need a paired batch with a 'sample' key "
+                         "(reconstruction-style evaluation)")
+    target = np.asarray(batch["sample"])
+    return target[:n]
+
+
+def get_psnr_metric(data_range: float = _DATA_RANGE) -> EvaluationMetric:
+    def fn(samples, batch):
+        target = _paired_target(batch, np.asarray(samples).shape[0])
+        return float(psnr(jnp.asarray(samples[: target.shape[0]]),
+                          jnp.asarray(target), data_range))
+    return EvaluationMetric(function=fn, name="psnr", higher_is_better=True)
+
+
+def get_ssim_metric(data_range: float = _DATA_RANGE) -> EvaluationMetric:
+    def fn(samples, batch):
+        target = _paired_target(batch, np.asarray(samples).shape[0])
+        return float(ssim(jnp.asarray(samples[: target.shape[0]]),
+                          jnp.asarray(target), data_range))
+    return EvaluationMetric(function=fn, name="ssim", higher_is_better=True)

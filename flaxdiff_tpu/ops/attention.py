@@ -45,6 +45,60 @@ def _xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out
 
 
+def _flash_specs(mesh, n_batch: int, n_heads: int):
+    """(batch_axes, head_axis) for shard-mapping flash attention over a
+    multi-device mesh, or None when the shapes don't tile it.
+
+    Batch shards over the data-like axes (data x fsdp — matching
+    mesh.batch_spec), heads over the tensor axis (Megatron head-parallel
+    attention). Everything else must stay unsharded inside the kernel.
+    """
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    batch_axes = tuple(a for a in ("data", "fsdp") if sizes.get(a, 1) > 1)
+    head_axis = "tensor" if sizes.get("tensor", 1) > 1 else None
+    if sizes.get("seq", 1) > 1:
+        return None   # a >1 seq axis belongs to the ring backend
+    n = int(np.prod([sizes[a] for a in batch_axes])) if batch_axes else 1
+    if n_batch % max(n, 1) != 0:
+        return None
+    if head_axis and n_heads % sizes[head_axis] != 0:
+        return None
+    return batch_axes, head_axis
+
+
+def _shard_mapped_flash(q: jax.Array, k: jax.Array, v: jax.Array,
+                        scale: float, mesh, batch_axes, head_axis,
+                        interpret: bool = False) -> jax.Array:
+    """Run the Pallas kernel per-device under shard_map.
+
+    A pallas_call is opaque to GSPMD — under plain jit on a >1-device
+    mesh the partitioner would replicate its operands rather than
+    partition the custom call. shard_map makes the parallelism explicit:
+    each device runs the kernel on its [b/dp, L, h/tp, d] shard; batch
+    and head sharding need no collectives (to_out's contraction over
+    sharded heads gets its all-reduce from GSPMD outside the kernel).
+    """
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    from .flash_attention import flash_attention
+
+    b_spec = (tuple(batch_axes) if len(batch_axes) > 1
+              else (batch_axes[0] if batch_axes else None))
+    spec = jax.sharding.PartitionSpec(b_spec, None, head_axis, None)
+    body = lambda a, b, c: flash_attention(a, b, c, scale=scale,
+                                           interpret=interpret)
+    kwargs = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    try:
+        # pallas_call primitives carry no varying-axis info; skip the check
+        fn = shard_map(body, check_vma=False, **kwargs)
+    except TypeError:
+        fn = shard_map(body, check_rep=False, **kwargs)
+    return fn(q, k, v)
+
+
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           backend: str = "auto",
                           scale: Optional[float] = None,
@@ -92,16 +146,30 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         from .flash_attention import flash_attention
         d = q.shape[-1]
         scale_eff = scale if scale is not None else 1.0 / (d ** 0.5)
+        # On a >1-device mesh the kernel must be shard-mapped (GSPMD
+        # replicates opaque custom calls); shapes that don't tile the
+        # mesh fall back to partitionable XLA attention instead.
+        from ..parallel.context import get_active_mesh
+        mesh = get_active_mesh()
+        sharded = None
+        if mesh is not None and mesh.devices.size > 1:
+            sharded = _flash_specs(mesh, q.shape[0], q.shape[2])
+            if sharded is None:
+                return _xla_attention(
+                    q, k, v, scale=scale,
+                    force_fp32_for_softmax=force_fp32_for_softmax)
         pad = (-d) % 128
         if pad:
             # Zero-padding head_dim is exact: padded dims contribute 0 to
             # q·k logits (scale stays 1/sqrt(d_orig)) and 0 to the padded
             # output channels, which are sliced off.
             widths = ((0, 0), (0, 0), (0, 0), (0, pad))
-            out = flash_attention(jnp.pad(q, widths), jnp.pad(k, widths),
-                                  jnp.pad(v, widths), scale=scale_eff)
-            return out[..., :d]
-        return flash_attention(q, k, v, scale=scale_eff)
+            q, k, v = (jnp.pad(t, widths) for t in (q, k, v))
+        if sharded is not None:
+            out = _shard_mapped_flash(q, k, v, scale_eff, mesh, *sharded)
+        else:
+            out = flash_attention(q, k, v, scale=scale_eff)
+        return out[..., :d] if pad else out
     if backend == "flash" and not attention_backend_available("flash"):
         import warnings
         warnings.warn("backend='flash' requested but no TPU is available; "

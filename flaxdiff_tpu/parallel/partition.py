@@ -80,25 +80,101 @@ def infer_fsdp_spec(shape: Tuple[int, ...], mesh: Mesh,
     return PartitionSpec(*spec)
 
 
+# Megatron-style tensor-parallel patterns over this repo's layer naming:
+# column-parallel projections shard their OUTPUT features (each device
+# computes its slice of heads / MLP hidden), row-parallel projections
+# shard their INPUT features (partial sums; GSPMD inserts the all-reduce
+# where the contraction crosses the tensor axis). Matched by path suffix,
+# applied only when rank and divisibility agree (see infer_tp_spec).
+_TP_COLUMN = re.compile(r"(to_q|to_k|to_v|proj_in|mlp_in)/(kernel|bias)$")
+_TP_ROW = re.compile(r"(to_out|proj_out|mlp_out)/(kernel|bias)$")
+
+
+def infer_tp_spec(name: str, shape: Tuple[int, ...], mesh: Mesh,
+                  axis: str = AXIS_TENSOR,
+                  min_size_2d: int = 2 ** 16) -> Optional[PartitionSpec]:
+    """Tensor-parallel PartitionSpec for one named tensor, or None.
+
+    Handles both nn.Dense ([din, dout] kernels) and the attention
+    nn.DenseGeneral layouts ([din, heads, head_dim] for to_q/k/v,
+    [heads, head_dim, dout] for to_out; head-sharded attention). Returns
+    None — caller falls through to FSDP inference — when the tensor axis
+    is absent/1, the name doesn't match, the rank is unexpected (e.g. a
+    conv-projection variant), or the sharded dim doesn't divide.
+    """
+    if axis not in mesh.axis_names:
+        return None
+    tp = mesh.devices.shape[mesh.axis_names.index(axis)]
+    if tp <= 1:
+        return None
+
+    def col_dim(rank: int) -> Optional[int]:
+        # output-features dim: Dense kernel [din, dout] -> 1;
+        # DenseGeneral qkv kernel [din, heads, hd] -> 1 (heads);
+        # bias [dout] -> 0; qkv bias [heads, hd] -> 0.
+        return {2: 1, 3: 1, 1: 0}.get(rank) if name.endswith("kernel") \
+            else {1: 0, 2: 0}.get(rank)
+
+    def row_dim(rank: int) -> Optional[int]:
+        # input-features dim: Dense kernel [din, dout] -> 0;
+        # to_out kernel [heads, hd, dout] -> 0 (heads);
+        # bias: replicated (added after the cross-device reduction).
+        return {2: 0, 3: 0}.get(rank) if name.endswith("kernel") else None
+
+    if _TP_COLUMN.search(name):
+        dim = col_dim(len(shape))
+    elif _TP_ROW.search(name):
+        if name.endswith("bias"):
+            return PartitionSpec()   # row-parallel bias stays replicated
+        dim = row_dim(len(shape))
+    else:
+        return None
+    if dim is None or shape[dim] % tp != 0:
+        return None
+    spec = [None] * len(shape)
+    spec[dim] = axis
+    # 2-D sharding for big kernels: lay FSDP over the largest remaining
+    # dim that divides, so TP tensors still contribute to ZeRO-3 memory
+    # savings. Small tensors and biases stay 1-D (gather latency would
+    # beat the memory saved).
+    if AXIS_FSDP in mesh.axis_names and name.endswith("kernel") \
+            and int(np.prod(shape)) >= min_size_2d:
+        fsdp = mesh.devices.shape[mesh.axis_names.index(AXIS_FSDP)]
+        if fsdp > 1:
+            rest = sorted((d for d in range(len(shape)) if d != dim),
+                          key=lambda d: shape[d], reverse=True)
+            for d in rest:
+                if shape[d] % fsdp == 0 and shape[d] >= fsdp:
+                    spec[d] = AXIS_FSDP
+                    break
+    return PartitionSpec(*spec)
+
+
 def fsdp_sharding_tree(params: PyTree, mesh: Mesh,
                        axis: str = AXIS_FSDP,
                        rules: Optional[Sequence[PartitionRule]] = None,
                        min_size: int = 2 ** 16) -> PyTree:
     """PartitionSpec tree for a param/optimizer pytree.
 
-    Explicit `rules` win where they match; remaining leaves fall back to
-    `infer_fsdp_spec`. Returns a tree of PartitionSpec with the same
-    structure as `params`.
+    Per leaf, in priority order: explicit `rules` win where they match;
+    then Megatron tensor-parallel inference (`infer_tp_spec`) when the
+    mesh has a >1 `tensor` axis; then `infer_fsdp_spec`. Returns a tree
+    of PartitionSpec with the same structure as `params`. Activating TP
+    is therefore purely a mesh decision — create_mesh(axes={...,
+    "tensor": n}) — with no trainer or model change.
     """
 
     def assign(path, leaf):
+        name = _path_str(path)
         if rules is not None:
-            name = _path_str(path)
             for pattern, spec in rules:
                 if re.search(pattern, name):
                     return spec
-        shape = getattr(leaf, "shape", ())
-        return infer_fsdp_spec(tuple(shape), mesh, axis, min_size)
+        shape = tuple(getattr(leaf, "shape", ()))
+        tp_spec = infer_tp_spec(name, shape, mesh)
+        if tp_spec is not None:
+            return tp_spec
+        return infer_fsdp_spec(shape, mesh, axis, min_size)
 
     return jax.tree_util.tree_map_with_path(assign, params)
 

@@ -125,6 +125,23 @@ class AutoEncoderTrainer:
         history["seconds"] = time.perf_counter() - t0
         return history
 
+    def evaluate(self, batch: PyTree, use_ema: bool = True) -> Dict[str, float]:
+        """Reconstruction quality (PSNR/SSIM dB, [-1,1] range) on a batch —
+        the metrics the reference stubbed out (its psnr.py/ssim.py are
+        empty files)."""
+        from ..metrics.image_quality import psnr, ssim
+        vae = self.trained_vae(use_ema=use_ema, scaling_factor=1.0)
+        x = jnp.asarray(np.asarray(batch["sample"]))
+        x = normalize_images(x) if self.config.normalize \
+            else x.astype(jnp.float32)
+        recon = vae.decode(vae.encode(x))
+        out = {"psnr": float(psnr(recon, x))}
+        # spatial dims are [-3, -2] for both image [B,H,W,C] and video
+        # [B,T,H,W,C] batches
+        if x.shape[-3] >= 11 and x.shape[-2] >= 11:
+            out["ssim"] = float(ssim(recon, x))
+        return out
+
     # -- export ---------------------------------------------------------------
     def trained_vae(self, use_ema: bool = True,
                     scaling_factor: Optional[float] = None) -> KLAutoEncoder:

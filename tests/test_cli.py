@@ -40,6 +40,27 @@ def test_cli_rejects_unknown_val_metric(tmp_path):
         _run(tmp_path, "--val_every", "2", "--val_metrics", "nope")
 
 
+def test_cli_validation_with_text_encoder_and_image_metrics(tmp_path):
+    """Guided validation sampling while a text encoder is active: the
+    conditioning handed to the sampler must mirror the train-step cond
+    pytree ({"text": ...}); psnr/ssim metrics ride the same run."""
+    hist = _run(tmp_path, "--dataset", "synthetic",
+                "--val_every", "2", "--val_samples", "4", "--val_steps", "2",
+                "--val_metrics", "psnr,ssim")
+    assert np.isfinite(hist["final_loss"])
+    log = [json.loads(line)
+           for line in open(tmp_path / "ckpt" / "train_log.jsonl")]
+    assert any("val/psnr" in rec for rec in log)
+    assert any("val/ssim" in rec for rec in log)
+
+
+def test_cli_tensor_parallel_mesh(tmp_path):
+    """--mesh_tensor 2 trains with Megatron TP specs on the virtual mesh."""
+    hist = _run(tmp_path, "--dataset", "synthetic",
+                "--mesh_data", "2", "--mesh_fsdp", "2", "--mesh_tensor", "2")
+    assert np.isfinite(hist["final_loss"])
+
+
 def test_cli_trains_video_with_audio_conditioning(tmp_path, make_av_file):
     """Video+audio end-to-end through the CLI: av_folder dataset ->
     MelAudioEncoder tokens -> UNet3D train steps."""

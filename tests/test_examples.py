@@ -1,0 +1,60 @@
+"""Every example script must run end-to-end in smoke mode.
+
+The examples are the framework's executable documentation (reference
+analogue: tutorial notebooks, which had no CI at all); these tests keep
+them from rotting.
+"""
+import importlib
+import os
+import sys
+
+import numpy as np
+import pytest
+
+EXAMPLES_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+def _run_example(fname, argv=("--smoke",)):
+    path = os.path.join(EXAMPLES_DIR, fname)
+    spec = importlib.util.spec_from_file_location(
+        f"example_{fname.removesuffix('.py')}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main(list(argv))
+
+
+def test_simple_diffusion_example():
+    hist = _run_example("01_simple_diffusion.py")
+    assert np.isfinite(hist["final_loss"])
+
+
+def test_edm_karras_example():
+    hist = _run_example("02_edm_karras.py")
+    assert np.isfinite(hist["final_loss"])
+
+
+def test_text_to_image_cfg_example():
+    out = _run_example("03_text_to_image_cfg.py")
+    assert np.isfinite(out["history"]["final_loss"])
+
+
+def test_multihost_fsdp_example():
+    hist = _run_example("04_multihost_fsdp.py")
+    assert hist["final_loss"] < hist["loss"][0]
+
+
+def test_latent_diffusion_example():
+    hist = _run_example("05_latent_diffusion.py")
+    assert np.isfinite(hist["final_loss"])
+
+
+def test_video_audio_example():
+    pytest.importorskip("cv2")
+    hist = _run_example("06_video_audio.py")
+    assert np.isfinite(hist["final_loss"])
+
+
+def test_ring_attention_example():
+    hist = _run_example("07_ring_attention.py")
+    assert np.isfinite(hist["final_loss"])

@@ -58,6 +58,9 @@ def parse_args(argv=None):
     p.add_argument("--mesh_data", type=int, default=-1)
     p.add_argument("--mesh_fsdp", type=int, default=1)
     p.add_argument("--mesh_seq", type=int, default=1)
+    p.add_argument("--mesh_tensor", type=int, default=1,
+                   help=">1 enables Megatron tensor parallelism over the "
+                        "tensor mesh axis (head-sharded attention)")
     # checkpoint / logging / validation
     p.add_argument("--checkpoint_dir", default="./checkpoints/run")
     p.add_argument("--save_every", type=int, default=1000)
@@ -109,7 +112,8 @@ def main(argv=None):
 
     # mesh
     mesh = create_mesh(axes={"data": args.mesh_data, "fsdp": args.mesh_fsdp,
-                             "seq": args.mesh_seq})
+                             "seq": args.mesh_seq,
+                             "tensor": args.mesh_tensor})
     print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
 
     # conditioning
@@ -265,6 +269,12 @@ def main(argv=None):
             elif name == "clip_score":
                 from flaxdiff_tpu.metrics import get_clip_score_metric
                 val_metrics.append(get_clip_score_metric())
+            elif name == "psnr":
+                from flaxdiff_tpu.metrics import get_psnr_metric
+                val_metrics.append(get_psnr_metric())
+            elif name == "ssim":
+                from flaxdiff_tpu.metrics import get_ssim_metric
+                val_metrics.append(get_ssim_metric())
             else:
                 raise SystemExit(f"unknown --val_metrics entry {name!r}")
         validator = Validator(
@@ -315,9 +325,12 @@ def main(argv=None):
         if validator is not None and done < args.total_steps:
             cond = unc = None
             if encoder is not None:
+                # conditioning must mirror the train-step cond pytree
+                # ({"text": ...}) — apply_fn routes on the dict key
                 prompts = ["a photo"] * args.val_samples
-                cond = jnp.asarray(encoder(prompts))
-                unc = input_config.get_unconditionals(args.val_samples)[0]
+                cond = {"text": jnp.asarray(encoder(prompts))}
+                unc = {"text": jnp.asarray(
+                    input_config.get_unconditionals(args.val_samples)[0])}
             real_batch = next(it)  # real images for FID / CLIP references
             result = validator.run(trainer.get_params(use_ema=True),
                                    conditioning=cond, unconditional=unc,
