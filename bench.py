@@ -131,6 +131,58 @@ def run(trainer, batches, batch, sync_every_step: bool, timed_steps: int):
     return timed_steps * batch / dt / n_chips, step_time, flops
 
 
+def bench_ddim_latency(image_size: int = 256, steps: int = 50,
+                       batch: int = 1, repeats: int = 5):
+    """50-step DDIM latency at 256^2 (BASELINE.md inference target).
+
+    The whole trajectory is ONE compiled lax.scan program (the
+    reference dispatches per step from a Python loop), so this measures
+    a single device program end to end. Returns median seconds.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from flaxdiff_tpu.models.unet import Unet
+    from flaxdiff_tpu.predictors import EpsilonPredictionTransform
+    from flaxdiff_tpu.samplers import DDIMSampler, DiffusionSampler
+    from flaxdiff_tpu.schedulers import CosineNoiseSchedule
+    from flaxdiff_tpu.utils import RngSeq
+
+    attn = {"heads": 8, "dim_head": 64, "backend": "auto"}
+    model = Unet(output_channels=3, emb_features=512,
+                 feature_depths=(64, 128, 256, 512),
+                 attention_configs=(None, None, dict(attn), dict(attn)),
+                 num_res_blocks=2, dtype=jnp.bfloat16)
+    ctx = jnp.zeros((batch, TEXT_LEN, TEXT_DIM))
+
+    def apply_fn(params, x, t, cond):
+        return model.apply({"params": params}, x, t,
+                           jnp.zeros((x.shape[0], TEXT_LEN, TEXT_DIM),
+                                     x.dtype))
+
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, image_size, image_size, 3)),
+                        jnp.zeros((1,)), ctx[:1])["params"]
+    engine = DiffusionSampler(model_fn=apply_fn,
+                              schedule=CosineNoiseSchedule(timesteps=1000),
+                              transform=EpsilonPredictionTransform(),
+                              sampler=DDIMSampler())
+
+    def run_once(seed):
+        out = engine.generate_samples(
+            params, num_samples=batch, resolution=image_size,
+            diffusion_steps=steps, rngstate=RngSeq.create(seed))
+        jax.block_until_ready(out)
+
+    run_once(0)  # compile
+    times = []
+    for i in range(repeats):
+        t0 = time.perf_counter()
+        run_once(i + 1)
+        times.append(time.perf_counter() - t0)
+    return sorted(times)[len(times) // 2]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--trace", default=None,
@@ -187,6 +239,18 @@ def main():
     ips_ref, _, _ = run(ref, make_batches(BASELINE_BATCH), BASELINE_BATCH,
                         sync_every_step=True, timed_steps=timed)
     log(f"reference-style: {ips_ref:.2f} imgs/sec/chip @ batch {BASELINE_BATCH}")
+    del ref
+
+    # Inference headline (BASELINE.md): 50-step DDIM at 256^2. Shrunk in
+    # --quick so CI smoke stays cheap.
+    log("measuring DDIM sampler latency...")
+    if args.quick:
+        ddim_s = bench_ddim_latency(image_size=64, steps=5, repeats=2)
+        ddim_key = "ddim5_latency_ms_64"
+    else:
+        ddim_s = bench_ddim_latency(image_size=256, steps=50, repeats=5)
+        ddim_key = "ddim50_latency_ms_256"
+    log(f"{ddim_key}: {ddim_s * 1e3:.1f} ms")
 
     print(json.dumps({
         "metric": "train_imgs_per_sec_per_chip_unet128_text_cond",
@@ -197,6 +261,7 @@ def main():
         "batch_per_chip": best_batch,
         "step_time_ms": round(step_time * 1e3, 2),
         "per_device_tflops_per_step": round(flops / 1e12, 3) if flops else None,
+        ddim_key: round(ddim_s * 1e3, 2),
         "baseline_kind": "same-framework-reference-semantics "
                          "(f32, XLA attn, per-step host sync, batch 16)",
     }))

@@ -22,7 +22,11 @@ import numpy as np
 from .sources.base import DataAugmenter, DataSource
 
 MAGIC = b"FDTR"
-VERSION = 1
+# v1: (offset u64, length u64) index entries, no checksums.
+# v2: (offset u64, length u64, crc32 u32, 0 u32) — per-record integrity
+#     (zlib crc32, matching the native reader's table). The reader
+#     handles both; the writer emits v2.
+VERSION = 2
 
 
 def pack_record(entries: Dict[str, bytes]) -> bytes:
@@ -66,16 +70,19 @@ class PackedRecordWriter:
         self._payload = open(self._payload_path, "wb")
         self._offsets: List[int] = []
         self._lengths: List[int] = []
+        self._crcs: List[int] = []
         self._pos = 0
         self._closed = False
 
     def write(self, record: Dict[str, bytes] | bytes):
         if self._closed:
             raise ValueError("writer closed")
+        import zlib
         blob = record if isinstance(record, (bytes, bytearray)) \
             else pack_record(record)
         self._offsets.append(self._pos)
         self._lengths.append(len(blob))
+        self._crcs.append(zlib.crc32(blob) & 0xFFFFFFFF)
         self._payload.write(blob)
         self._pos += len(blob)
 
@@ -90,8 +97,9 @@ class PackedRecordWriter:
                 f.write(MAGIC)
                 f.write(struct.pack("<I", VERSION))
                 f.write(struct.pack("<Q", n))
-                for off, length in zip(self._offsets, self._lengths):
-                    f.write(struct.pack("<QQ", off, length))
+                for off, length, crc in zip(self._offsets, self._lengths,
+                                            self._crcs):
+                    f.write(struct.pack("<QQII", off, length, crc, 0))
                 with open(self._payload_path, "rb") as payload:
                     shutil.copyfileobj(payload, f, length=16 * 1024 * 1024)
         finally:
@@ -130,6 +138,56 @@ class PackedRecordReader:
 
     def __getitem__(self, idx: int) -> Dict[str, bytes]:
         return unpack_record(self.record_bytes(idx))
+
+    @property
+    def version(self) -> int:
+        return int(self._lib.pr_version(self._handle))
+
+    def read_batch(self, idxs) -> List[bytes]:
+        """Fetch many records in ONE native call (the per-record ctypes
+        crossing dominates small-record read cost from Python)."""
+        idxs = [int(i) for i in idxs]
+        n = len(idxs)
+        if n == 0:
+            return []
+        for i in idxs:
+            if not 0 <= i < len(self):
+                raise IndexError(f"record {i} out of range (n={len(self)})")
+        total = sum(int(self._lib.pr_record_length(self._handle, i))
+                    for i in idxs)
+        buf = ctypes.create_string_buffer(max(total, 1))
+        arr = (ctypes.c_uint64 * n)(*idxs)
+        lengths = (ctypes.c_uint64 * n)()
+        wrote = int(self._lib.pr_read_batch(self._handle, arr, n, buf,
+                                            total, lengths))
+        if wrote != total:
+            raise IOError(f"batch read failed ({wrote} != {total} bytes)")
+        raw = buf.raw  # one materialization; .raw copies on every access
+        out, pos = [], 0
+        for i in range(n):
+            ln = int(lengths[i])
+            out.append(raw[pos:pos + ln])
+            pos += ln
+        return out
+
+    def prefetch(self, idxs) -> None:
+        """madvise(WILLNEED) the upcoming records' pages (readahead hint
+        for cold page cache; no-op semantics otherwise)."""
+        idxs = [int(i) for i in idxs if 0 <= int(i) < len(self)]
+        if idxs:
+            arr = (ctypes.c_uint64 * len(idxs))(*idxs)
+            self._lib.pr_prefetch(self._handle, arr, len(idxs))
+
+    def verify(self, idx: int) -> bool:
+        """CRC check one record (v2 files; v1 has no checksums -> True)."""
+        idx = int(idx)
+        if not 0 <= idx < len(self):
+            raise IndexError(f"record {idx} out of range (n={len(self)})")
+        return bool(self._lib.pr_verify_record(self._handle, idx))
+
+    def verify_all(self) -> int:
+        """Full-file integrity scan; returns the number of corrupt records."""
+        return int(self._lib.pr_verify_all(self._handle))
 
     def close(self):
         if getattr(self, "_handle", None):

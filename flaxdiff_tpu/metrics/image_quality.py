@@ -94,25 +94,35 @@ def ssim(pred: jnp.ndarray, target: jnp.ndarray,
     return jnp.mean(s)
 
 
-def _paired_target(batch: Optional[dict], n: int) -> np.ndarray:
+def _paired_pair(samples, batch: Optional[dict]):
+    """(pred, target) as float32 [0,1] pairs; data_range is then 1.
+
+    Generated samples arrive as [-1,1] floats; the validation batch's
+    'sample' is whatever the loader yields (uint8 [0,255] from grain —
+    the train step normalizes in-jit, so the raw batch never is). Route
+    BOTH through the shared range heuristic (utils.to_unit_float, same
+    as FID/grid logging) so the comparison is range-consistent.
+    """
+    from ..utils import to_unit_float
     if not batch or "sample" not in batch:
         raise ValueError("psnr/ssim need a paired batch with a 'sample' key "
                          "(reconstruction-style evaluation)")
-    target = np.asarray(batch["sample"])
-    return target[:n]
+    target = to_unit_float(batch["sample"])
+    pred = to_unit_float(samples)[: target.shape[0]]
+    return pred, target[: pred.shape[0]]
 
 
-def get_psnr_metric(data_range: float = _DATA_RANGE) -> EvaluationMetric:
+def get_psnr_metric() -> EvaluationMetric:
     def fn(samples, batch):
-        target = _paired_target(batch, np.asarray(samples).shape[0])
-        return float(psnr(jnp.asarray(samples[: target.shape[0]]),
-                          jnp.asarray(target), data_range))
+        pred, target = _paired_pair(samples, batch)
+        return float(psnr(jnp.asarray(pred), jnp.asarray(target),
+                          data_range=1.0))
     return EvaluationMetric(function=fn, name="psnr", higher_is_better=True)
 
 
-def get_ssim_metric(data_range: float = _DATA_RANGE) -> EvaluationMetric:
+def get_ssim_metric() -> EvaluationMetric:
     def fn(samples, batch):
-        target = _paired_target(batch, np.asarray(samples).shape[0])
-        return float(ssim(jnp.asarray(samples[: target.shape[0]]),
-                          jnp.asarray(target), data_range))
+        pred, target = _paired_pair(samples, batch)
+        return float(ssim(jnp.asarray(pred), jnp.asarray(target),
+                          data_range=1.0))
     return EvaluationMetric(function=fn, name="ssim", higher_is_better=True)

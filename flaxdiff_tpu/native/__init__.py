@@ -57,6 +57,18 @@ def load_packed_reader() -> ctypes.CDLL:
         lib.pr_read_record.restype = ctypes.c_uint64
         lib.pr_read_record.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                                        ctypes.c_void_p, ctypes.c_uint64]
+        lib.pr_version.restype = ctypes.c_uint32
+        lib.pr_version.argtypes = [ctypes.c_void_p]
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.pr_read_batch.restype = ctypes.c_uint64
+        lib.pr_read_batch.argtypes = [ctypes.c_void_p, u64p, ctypes.c_uint64,
+                                      ctypes.c_void_p, ctypes.c_uint64, u64p]
+        lib.pr_prefetch.restype = None
+        lib.pr_prefetch.argtypes = [ctypes.c_void_p, u64p, ctypes.c_uint64]
+        lib.pr_verify_record.restype = ctypes.c_int32
+        lib.pr_verify_record.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.pr_verify_all.restype = ctypes.c_uint64
+        lib.pr_verify_all.argtypes = [ctypes.c_void_p]
         lib.pr_close.restype = None
         lib.pr_close.argtypes = [ctypes.c_void_p]
         _lib = lib
