@@ -40,7 +40,7 @@ TEXT_LEN = 77
 TEXT_DIM = 768
 WARMUP_STEPS = 3
 TIMED_STEPS = 30
-BATCH_SWEEP = (16, 32, 64, 128)
+BATCH_SWEEP = (16, 32, 64, 128, 256)  # sweep stops at the first OOM
 BASELINE_BATCH = 16  # the reference's documented flowers config batch
 
 

@@ -60,9 +60,9 @@ def unpack_record(data: bytes) -> Dict[str, bytes]:
 
 class PackedRecordWriter:
     """Streams records to disk as they arrive (payload goes to a temp file;
-    only the 16-byte-per-record index stays in memory), then assembles
-    header + index + payload at close — corpus-sized datasets never need
-    corpus-sized RAM."""
+    only the 24-byte-per-record index — offset, length, crc32 — stays in
+    memory), then assembles header + index + payload at close —
+    corpus-sized datasets never need corpus-sized RAM."""
 
     def __init__(self, path: str):
         self.path = path
@@ -144,19 +144,22 @@ class PackedRecordReader:
         return int(self._lib.pr_version(self._handle))
 
     def read_batch(self, idxs) -> List[bytes]:
-        """Fetch many records in ONE native call (the per-record ctypes
-        crossing dominates small-record read cost from Python)."""
+        """Fetch many records in TWO native calls total — size then copy —
+        instead of the per-record ctypes crossing that dominates
+        small-record read cost from Python."""
         idxs = [int(i) for i in idxs]
         n = len(idxs)
         if n == 0:
             return []
+        n_rec = len(self)
         for i in idxs:
-            if not 0 <= i < len(self):
-                raise IndexError(f"record {i} out of range (n={len(self)})")
-        total = sum(int(self._lib.pr_record_length(self._handle, i))
-                    for i in idxs)
-        buf = ctypes.create_string_buffer(max(total, 1))
+            if not 0 <= i < n_rec:
+                raise IndexError(f"record {i} out of range (n={n_rec})")
         arr = (ctypes.c_uint64 * n)(*idxs)
+        total = int(self._lib.pr_batch_length(self._handle, arr, n))
+        if total == 2 ** 64 - 1:
+            raise IOError("batch length query failed")
+        buf = ctypes.create_string_buffer(max(total, 1))
         lengths = (ctypes.c_uint64 * n)()
         wrote = int(self._lib.pr_read_batch(self._handle, arr, n, buf,
                                             total, lengths))
@@ -173,7 +176,8 @@ class PackedRecordReader:
     def prefetch(self, idxs) -> None:
         """madvise(WILLNEED) the upcoming records' pages (readahead hint
         for cold page cache; no-op semantics otherwise)."""
-        idxs = [int(i) for i in idxs if 0 <= int(i) < len(self)]
+        n_rec = len(self)
+        idxs = [int(i) for i in idxs if 0 <= int(i) < n_rec]
         if idxs:
             arr = (ctypes.c_uint64 * len(idxs))(*idxs)
             self._lib.pr_prefetch(self._handle, arr, len(idxs))

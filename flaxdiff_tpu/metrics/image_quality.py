@@ -97,18 +97,21 @@ def ssim(pred: jnp.ndarray, target: jnp.ndarray,
 def _paired_pair(samples, batch: Optional[dict]):
     """(pred, target) as float32 [0,1] pairs; data_range is then 1.
 
-    Generated samples arrive as [-1,1] floats; the validation batch's
-    'sample' is whatever the loader yields (uint8 [0,255] from grain —
-    the train step normalizes in-jit, so the raw batch never is). Route
-    BOTH through the shared range heuristic (utils.to_unit_float, same
-    as FID/grid logging) so the comparison is range-consistent.
+    Generated samples are [-1,1] floats BY CONTRACT (the sampler's
+    output space, samplers/common.py generate_samples) — map them with
+    the fixed (x+1)/2, never the value heuristic, which would misread a
+    bright batch with no pixel below ~0 as already [0,1]. The validation
+    batch's 'sample' is whatever the loader yields (uint8 [0,255] from
+    grain; normalization happens in-jit), so it goes through the shared
+    range heuristic (utils.to_unit_float, same as FID/grid logging).
     """
     from ..utils import to_unit_float
     if not batch or "sample" not in batch:
         raise ValueError("psnr/ssim need a paired batch with a 'sample' key "
                          "(reconstruction-style evaluation)")
     target = to_unit_float(batch["sample"])
-    pred = to_unit_float(samples)[: target.shape[0]]
+    pred = np.clip((np.asarray(samples, np.float32) + 1.0) / 2.0, 0.0, 1.0)
+    pred = pred[: target.shape[0]]
     return pred, target[: pred.shape[0]]
 
 

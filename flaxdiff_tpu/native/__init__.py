@@ -60,6 +60,9 @@ def load_packed_reader() -> ctypes.CDLL:
         lib.pr_version.restype = ctypes.c_uint32
         lib.pr_version.argtypes = [ctypes.c_void_p]
         u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.pr_batch_length.restype = ctypes.c_uint64
+        lib.pr_batch_length.argtypes = [ctypes.c_void_p, u64p,
+                                        ctypes.c_uint64]
         lib.pr_read_batch.restype = ctypes.c_uint64
         lib.pr_read_batch.argtypes = [ctypes.c_void_p, u64p, ctypes.c_uint64,
                                       ctypes.c_void_p, ctypes.c_uint64, u64p]

@@ -187,6 +187,19 @@ uint64_t pr_read_record(void* handle, uint64_t idx, void* buf,
   return len;
 }
 
+// Total payload size of a batch of records, for sizing the read buffer
+// in one native call. Returns UINT64_MAX on any out-of-range index.
+uint64_t pr_batch_length(void* handle, const uint64_t* idxs, uint64_t n) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (!r || !idxs) return UINT64_MAX;
+  uint64_t total = 0;
+  for (uint64_t i = 0; i < n; ++i) {
+    if (idxs[i] >= r->num_records) return UINT64_MAX;
+    total += r->length(idxs[i]);
+  }
+  return total;
+}
+
 // Batched copying read: records land back-to-back in buf, per-record
 // lengths in out_lengths. ONE ctypes crossing per batch instead of per
 // record. Returns total bytes written, or 0 on any error (bad index /

@@ -43,6 +43,17 @@ class TrainerConfig:
     abnormal_loss_floor: float = 1e-8
     keep_best_state: bool = True
     seed: int = 0
+    # Preemption safety: on SIGTERM (the TPU-pod eviction signal) the fit
+    # loop checkpoints and returns cleanly instead of dying mid-step.
+    # The reference has no preemption handling (a host loss kills the
+    # job, SURVEY §5.3).
+    checkpoint_on_sigterm: bool = True
+    # In-training profiler capture: when set, a jax.profiler trace of
+    # `profile_steps` steps starting at `profile_at_step` (post-warmup)
+    # lands in profile_dir.
+    profile_dir: Optional[str] = None
+    profile_at_step: int = 10
+    profile_steps: int = 5
 
 
 class DiffusionTrainer:
@@ -224,67 +235,118 @@ class DiffusionTrainer:
         peak = device_peak_flops()
         flops = None
         history: Dict[str, Any] = {"steps": [], "loss": [], "imgs_per_sec": [],
-                                   "mfu": []}
+                                   "mfu": [], "preempted": False}
+
+        # SIGTERM -> finish the current step, checkpoint, return. Only the
+        # main thread may install handlers; elsewhere (e.g. fit driven
+        # from a worker thread) preemption safety is skipped silently.
+        import signal
+        stop = {"flag": False}
+        prev_handler = None
+        handler_installed = False
+        if cfg.checkpoint_on_sigterm:
+            def _on_term(signum, frame):
+                stop["flag"] = True
+                if callable(prev_handler):
+                    prev_handler(signum, frame)
+            try:
+                prev_handler = signal.signal(signal.SIGTERM, _on_term)
+                handler_installed = True
+            except ValueError:
+                pass
+
+        profile_ctx = None
+        # Clamp the capture window into the run so a short fit with
+        # profile_dir set still produces a trace instead of silently
+        # never reaching the default start step.
+        profile_at = min(cfg.profile_at_step,
+                         max(total_steps - cfg.profile_steps + 1, 1))
 
         # one-deep device double buffering: while the device runs step N
         # (dispatch is async), the host fetches and uploads batch N+1 —
         # the H2D copy hides behind compute instead of serializing with
         # it (the reference pays this copy on the critical path every
         # step, simple_trainer.py:530-533).
-        batch = next(data)
-        global_batch = self.put_batch(batch)
-        for i in range(total_steps):
-            current = global_batch
-            pending_loss = self.train_step(current)
-            if i + 1 < total_steps:
-                batch = next(data)
-                global_batch = self.put_batch(batch)
-            steps_in_window += 1
+        # try/finally: an exception escaping the loop (exhausted iterator,
+        # raising callback) must still restore the SIGTERM handler — a
+        # leaked _on_term would swallow every later SIGTERM — and close
+        # any open profiler trace.
+        try:
+            batch = next(data)
+            global_batch = self.put_batch(batch)
+            for i in range(total_steps):
+                if stop["flag"]:
+                    history["preempted"] = True
+                    self.save_checkpoint(force=True)
+                    break
+                if cfg.profile_dir is not None:
+                    from ..profiling import trace
+                    if i + 1 == profile_at and profile_ctx is None:
+                        profile_ctx = trace(cfg.profile_dir)
+                        profile_ctx.__enter__()
+                    elif (profile_ctx is not None
+                            and i + 1 == profile_at + cfg.profile_steps):
+                        jax.block_until_ready(pending_loss)
+                        profile_ctx.__exit__(None, None, None)
+                        profile_ctx = None
+                current = global_batch
+                pending_loss = self.train_step(current)
+                if i + 1 < total_steps:
+                    batch = next(data)
+                    global_batch = self.put_batch(batch)
+                steps_in_window += 1
 
-            if (i + 1) % cfg.log_every == 0 or i == total_steps - 1:
-                loss = float(pending_loss)
-                if not np.isfinite(loss) or loss <= cfg.abnormal_loss_floor:
-                    self._recover(loss)
+                if (i + 1) % cfg.log_every == 0 or i == total_steps - 1:
+                    loss = float(pending_loss)
+                    if not np.isfinite(loss) or loss <= cfg.abnormal_loss_floor:
+                        self._recover(loss)
+                        steps_in_window = 0
+                        log_t0 = time.perf_counter()
+                        continue
+                    losses.append(loss)
+                    dt = time.perf_counter() - log_t0
+                    bsz = jax.tree_util.tree_leaves(batch)[0].shape[0] \
+                        * jax.process_count()
+                    ips = steps_in_window * bsz / max(dt, 1e-9)
+                    if flops is None and peak:
+                        flops = self.step_flops(global_batch)
+                    step_mfu = (mfu(flops, dt / steps_in_window, peak)
+                                if flops else None)
                     steps_in_window = 0
+                    history["steps"].append(i + 1)
+                    history["loss"].append(loss)
+                    history["imgs_per_sec"].append(ips)
+                    history["mfu"].append(step_mfu)
+                    metrics = {"imgs_per_sec": ips}
+                    if step_mfu is not None:
+                        metrics["mfu"] = step_mfu
+                    for cb in callbacks:
+                        cb(i + 1, loss, metrics)
+                    if cfg.keep_best_state and loss < self.best_loss:
+                        self.best_loss = loss
+                        self.best_state = jax.tree_util.tree_map(
+                            jnp.copy, self.state)
                     log_t0 = time.perf_counter()
-                    continue
-                losses.append(loss)
-                dt = time.perf_counter() - log_t0
-                bsz = jax.tree_util.tree_leaves(batch)[0].shape[0] \
-                    * jax.process_count()
-                ips = steps_in_window * bsz / max(dt, 1e-9)
-                if flops is None and peak:
-                    flops = self.step_flops(global_batch)
-                step_mfu = (mfu(flops, dt / steps_in_window, peak)
-                            if flops else None)
-                steps_in_window = 0
-                history["steps"].append(i + 1)
-                history["loss"].append(loss)
-                history["imgs_per_sec"].append(ips)
-                history["mfu"].append(step_mfu)
-                metrics = {"imgs_per_sec": ips}
-                if step_mfu is not None:
-                    metrics["mfu"] = step_mfu
-                for cb in callbacks:
-                    cb(i + 1, loss, metrics)
-                if cfg.keep_best_state and loss < self.best_loss:
-                    self.best_loss = loss
-                    self.best_state = jax.tree_util.tree_map(
-                        jnp.copy, self.state)
-                log_t0 = time.perf_counter()
 
-            if save_every and (i + 1) % save_every == 0:
-                # Guard the save with a loss check: a NaN at step N must
-                # not be checkpointed while the log-cadence check is
-                # still log_every-1 steps away (VERDICT r1 weak #4). The
-                # sync this forces is amortized over save_every steps.
-                loss_now = float(pending_loss)
-                if (not np.isfinite(loss_now)
-                        or loss_now <= cfg.abnormal_loss_floor):
-                    self._recover(loss_now)
-                else:
-                    self.save_checkpoint()
+                if save_every and (i + 1) % save_every == 0:
+                    # Guard the save with a loss check: a NaN at step N must
+                    # not be checkpointed while the log-cadence check is
+                    # still log_every-1 steps away (VERDICT r1 weak #4). The
+                    # sync this forces is amortized over save_every steps.
+                    loss_now = float(pending_loss)
+                    if (not np.isfinite(loss_now)
+                            or loss_now <= cfg.abnormal_loss_floor):
+                        self._recover(loss_now)
+                    else:
+                        self.save_checkpoint()
 
+        finally:
+            if profile_ctx is not None:
+                profile_ctx.__exit__(None, None, None)
+            if handler_installed:
+                signal.signal(signal.SIGTERM,
+                              prev_handler if prev_handler is not None
+                              else signal.SIG_DFL)
         self.save_checkpoint(force=True)
         history["final_loss"] = losses[-1] if losses else float("nan")
         history["best_loss"] = self.best_loss

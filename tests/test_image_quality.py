@@ -70,6 +70,18 @@ def test_metric_factories_pair_against_batch(rng):
     assert m_psnr.function(np.concatenate([noisy, noisy]), batch) == p
 
 
+def test_metric_factories_bright_samples_vs_uint8_batch(rng):
+    """A bright sample batch (no pixel below 0) must still be mapped by
+    the fixed [-1,1]->[0,1] contract, not a value heuristic: scored
+    against its own uint8 rendering, PSNR is near-lossless."""
+    pred = rng.uniform(0.2, 1.0, size=(2, 16, 16, 3)).astype(np.float32)
+    target_u8 = np.round((pred + 1.0) / 2.0 * 255.0).astype(np.uint8)
+    p = get_psnr_metric().function(pred, {"sample": target_u8})
+    assert p > 40.0, p   # only uint8 quantization error remains
+    s = get_ssim_metric().function(pred, {"sample": target_u8})
+    assert s > 0.98, s
+
+
 def test_metric_factories_require_paired_batch(rng):
     x = rng.uniform(-1, 1, size=(2, 16, 16, 3)).astype(np.float32)
     with pytest.raises(ValueError, match="paired batch"):

@@ -65,6 +65,9 @@ def parse_args(argv=None):
     p.add_argument("--checkpoint_dir", default="./checkpoints/run")
     p.add_argument("--save_every", type=int, default=1000)
     p.add_argument("--log_every", type=int, default=100)
+    p.add_argument("--profile_dir", default=None,
+                   help="capture a jax.profiler trace of a few post-warmup "
+                        "steps into this directory")
     p.add_argument("--val_every", type=int, default=0,
                    help="0 disables in-loop validation")
     p.add_argument("--val_samples", type=int, default=8)
@@ -236,7 +239,8 @@ def main(argv=None):
         transform=transform, mesh=mesh,
         config=TrainerConfig(ema_decay=args.ema_decay,
                              uncond_prob=args.uncond_prob,
-                             log_every=args.log_every, seed=args.seed),
+                             log_every=args.log_every, seed=args.seed,
+                             profile_dir=args.profile_dir),
         policy=policy, null_cond=null_cond, checkpointer=ckpt)
 
     if ckpt.latest_step() is not None:
