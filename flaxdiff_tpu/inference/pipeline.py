@@ -150,9 +150,12 @@ class DiffusionInferencePipeline:
                          use_ema: bool = True,
                          seed: int = 42,
                          sequence_length: Optional[int] = None,
-                         channels: int = 3) -> np.ndarray:
+                         channels: int = 3,
+                         inpaint_reference=None,
+                         inpaint_mask=None) -> np.ndarray:
         """Generate images/videos; prompts are encoded through the input
-        config when given (reference pipeline.py:217-272)."""
+        config when given (reference pipeline.py:217-272). Inpainting:
+        see DiffusionSampler.generate_samples."""
         params = (self.ema_params
                   if use_ema and self.ema_params is not None else self.params)
         conditioning = unconditional = None
@@ -169,7 +172,8 @@ class DiffusionInferencePipeline:
             params=params, num_samples=num_samples, resolution=resolution,
             diffusion_steps=diffusion_steps, rngstate=RngSeq.create(seed),
             sequence_length=sequence_length, channels=channels,
-            conditioning=conditioning, unconditional=unconditional)
+            conditioning=conditioning, unconditional=unconditional,
+            inpaint_reference=inpaint_reference, inpaint_mask=inpaint_mask)
         return np.asarray(jax.device_get(out))
 
 

@@ -170,8 +170,9 @@ class DiffusionSampler:
 
     # -- one compiled program per (steps, shape) ----------------------------
     def _get_program(self, num_steps: int, shape: Tuple[int, ...],
-                     start: Optional[float], end: float):
-        cache_key = (num_steps, shape, start, end)
+                     start: Optional[float], end: float,
+                     inpaint: bool = False):
+        cache_key = (num_steps, shape, start, end, inpaint)
         if cache_key in self._compiled:
             return self._compiled[cache_key]
 
@@ -179,7 +180,7 @@ class DiffusionSampler:
                                      self.schedule.timesteps, start, end,
                                      schedule=self.schedule)
 
-        def program(params, x_init, key, cond, uncond):
+        def program(params, x_init, key, cond, uncond, mask=None, known=None):
             denoise = self._denoise_fn(params, cond, uncond)
             pairs = jnp.stack([steps[:-1], steps[1:]], axis=1)
 
@@ -190,6 +191,17 @@ class DiffusionSampler:
                 rng, sub = jax.random.split(rng)
                 x_next, state = self.sampler.step(
                     denoise, x, t_cur, t_next, sub, state, self.schedule, idx)
+                if inpaint:
+                    # Masked generation (SD-inpainting "replacement"
+                    # semantics): outside the mask the trajectory is
+                    # pinned to the reference, re-noised to the step's
+                    # noise level so the generated region blends against
+                    # a statistically consistent neighborhood.
+                    rng, nk = jax.random.split(rng)
+                    noise = jax.random.normal(nk, known.shape, known.dtype)
+                    t_b = jnp.full((x.shape[0],), t_next)
+                    known_t = self.schedule.add_noise(known, noise, t_b)
+                    x_next = mask * x_next + (1.0 - mask) * known_t
                 return (x_next, rng, state), ()
 
             state0 = self.sampler.init_state(x_init)
@@ -199,6 +211,8 @@ class DiffusionSampler:
             # terminal denoise: plain model call at the final step value
             # (reference samplers/common.py:384-388)
             x0, _ = denoise(x, jnp.full((x.shape[0],), steps[-1]))
+            if inpaint:
+                x0 = mask * x0 + (1.0 - mask) * known
             return x0
 
         compiled = jax.jit(program)
@@ -217,11 +231,21 @@ class DiffusionSampler:
                          end_step: float = 0.0,
                          sequence_length: Optional[int] = None,
                          channels: int = 3,
-                         decode: bool = True) -> jax.Array:
+                         decode: bool = True,
+                         inpaint_reference: Optional[jax.Array] = None,
+                         inpaint_mask: Optional[jax.Array] = None) -> jax.Array:
         """Run the scan program; returns decoded samples in [-1, 1] space.
 
         Image shape: [N, R, R, C]; video when sequence_length is given:
         [N, T, R, R, C] (reference samplers/common.py:412-430).
+
+        Inpainting (capability the reference lacks): pass
+        `inpaint_reference` ([-1,1] pixel/video space, full sample shape)
+        and `inpaint_mask` (1 = generate, 0 = keep reference; spatial
+        shape, broadcastable over channels). With an autoencoder the
+        reference is encoded and the mask is nearest-resized to the
+        latent grid. The whole masked trajectory still runs in the one
+        compiled scan.
         """
         rngstate = rngstate or RngSeq.create(42)
         rngstate, noise_key = rngstate.next_key()
@@ -236,14 +260,43 @@ class DiffusionSampler:
         else:
             shape = (num_samples, resolution, resolution, channels)
 
+        inpaint = inpaint_reference is not None
+        mask = known = None
+        if inpaint:
+            if inpaint_mask is None:
+                raise ValueError("inpaint_reference requires inpaint_mask")
+            known = jnp.asarray(inpaint_reference, jnp.float32)
+            if self.autoencoder is not None:
+                known = self.autoencoder.encode(known)
+            if known.shape != shape:
+                raise ValueError(f"inpaint_reference encodes to "
+                                 f"{known.shape}, expected {shape}")
+            mask = jnp.asarray(inpaint_mask, jnp.float32)
+            if mask.ndim == known.ndim - 1:      # no channel dim: add one
+                mask = mask[..., None]
+            elif mask.ndim != known.ndim:
+                raise ValueError(
+                    f"inpaint_mask rank {mask.ndim} incompatible with "
+                    f"sample rank {known.ndim} (pass [batch, (frames,) "
+                    f"H, W] or with a trailing channel dim)")
+            if mask.shape[-3:-1] != known.shape[-3:-1]:
+                mask = jax.image.resize(
+                    mask, mask.shape[:-3] + known.shape[-3:-1]
+                    + mask.shape[-1:], method="nearest")
+            mask = jnp.broadcast_to(mask, known.shape).astype(jnp.float32)
+
         if init_samples is None:
             x = jax.random.normal(noise_key, shape) * self.schedule.max_noise_std()
         else:
             x = init_samples
 
         program = self._get_program(diffusion_steps, tuple(shape),
-                                    start_step, end_step)
-        x0 = program(params, x, loop_key, conditioning, unconditional)
+                                    start_step, end_step, inpaint=inpaint)
+        if inpaint:
+            x0 = program(params, x, loop_key, conditioning, unconditional,
+                         mask, known)
+        else:
+            x0 = program(params, x, loop_key, conditioning, unconditional)
 
         if decode and self.autoencoder is not None:
             x0 = self.autoencoder.decode(x0)

@@ -256,11 +256,12 @@ class DiffusionTrainer:
                 pass
 
         profile_ctx = None
-        # Clamp the capture window into the run so a short fit with
-        # profile_dir set still produces a trace instead of silently
-        # never reaching the default start step.
-        profile_at = min(cfg.profile_at_step,
-                         max(total_steps - cfg.profile_steps + 1, 1))
+        # Clamp the capture window into [1, total_steps] so a short fit
+        # with profile_dir set still produces a trace instead of silently
+        # never reaching the default start step (the close is handled in
+        # `finally` when the window runs past the last step).
+        profile_at = max(1, min(cfg.profile_at_step,
+                                max(total_steps - cfg.profile_steps + 1, 1)))
 
         # one-deep device double buffering: while the device runs step N
         # (dispatch is async), the host fetches and uploads batch N+1 —
@@ -276,8 +277,9 @@ class DiffusionTrainer:
             global_batch = self.put_batch(batch)
             for i in range(total_steps):
                 if stop["flag"]:
+                    # the post-loop force-save persists the state; here
+                    # only mark and stop
                     history["preempted"] = True
-                    self.save_checkpoint(force=True)
                     break
                 if cfg.profile_dir is not None:
                     from ..profiling import trace
@@ -342,6 +344,11 @@ class DiffusionTrainer:
 
         finally:
             if profile_ctx is not None:
+                # sync before closing so async-dispatched steps' device
+                # activity lands in the trace (windows that run past the
+                # last step close here instead of in-loop)
+                if pending_loss is not None:
+                    jax.block_until_ready(pending_loss)
                 profile_ctx.__exit__(None, None, None)
             if handler_installed:
                 signal.signal(signal.SIGTERM,
