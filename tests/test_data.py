@@ -74,6 +74,17 @@ def test_grain_pipeline_end_to_end(toy_images):
     assert batch["sample"].shape == (8, 16, 16, 3)
 
 
+def test_grain_throughput_knobs(toy_images):
+    """worker_buffer_size / read_threads / read_buffer_size plumb through
+    to grain (the tuning surface the reference exposes, training.py:84-99)."""
+    ds = get_dataset("synthetic", n=32, image_size=8)
+    loaded = get_dataset_grain(ds, batch_size=8, image_size=8,
+                               worker_buffer_size=2, read_threads=2,
+                               read_buffer_size=4)
+    batch = next(loaded["train"](seed=0))
+    assert batch["sample"].shape == (8, 8, 8, 3)
+
+
 def test_grain_shuffles_between_epochs(toy_images):
     ds = get_dataset("synthetic", n=16, image_size=8)
     loaded = get_dataset_grain(ds, batch_size=16, image_size=8)

@@ -32,6 +32,11 @@ def parse_args(argv=None):
                    help="condition video models on clip audio")
     p.add_argument("--batch_size", type=int, default=64)
     p.add_argument("--grain_workers", type=int, default=0)
+    # grain throughput knobs (reference training.py:84-99 defaults at
+    # corpus scale: 32 workers / 140 read threads / buffers 96/100)
+    p.add_argument("--grain_worker_buffer", type=int, default=1)
+    p.add_argument("--grain_read_threads", type=int, default=None)
+    p.add_argument("--grain_read_buffer", type=int, default=None)
     # model
     p.add_argument("--architecture", default="unet",
                    help="registry name, e.g. unet, simple_dit+hilbert")
@@ -172,6 +177,9 @@ def main(argv=None):
         loaded = get_dataset_grain(dataset, batch_size=args.batch_size,
                                    image_size=args.image_size,
                                    worker_count=args.grain_workers,
+                                   worker_buffer_size=args.grain_worker_buffer,
+                                   read_threads=args.grain_read_threads,
+                                   read_buffer_size=args.grain_read_buffer,
                                    seed=args.seed)
 
     # model
