@@ -54,6 +54,13 @@ def test_cli_validation_with_text_encoder_and_image_metrics(tmp_path):
     assert any("val/ssim" in rec for rec in log)
 
 
+def test_cli_gradient_accumulation(tmp_path):
+    """--grad_accum wraps the optimizer in optax.MultiSteps; training
+    still runs and the FSDP sharding of the wrapped opt state compiles."""
+    hist = _run(tmp_path, "--dataset", "synthetic", "--grad_accum", "2")
+    assert np.isfinite(hist["final_loss"])
+
+
 def test_cli_tensor_parallel_mesh(tmp_path):
     """--mesh_tensor 2 trains with Megatron TP specs on the virtual mesh."""
     hist = _run(tmp_path, "--dataset", "synthetic",

@@ -58,6 +58,9 @@ def parse_args(argv=None):
     p.add_argument("--warmup_steps", type=int, default=10000)
     p.add_argument("--total_steps", type=int, default=100000)
     p.add_argument("--grad_clip", type=float, default=1.0)
+    p.add_argument("--grad_accum", type=int, default=1,
+                   help=">1 accumulates gradients over k micro-batches "
+                        "per optimizer update (optax.MultiSteps)")
     p.add_argument("--ema_decay", type=float, default=0.999)
     # parallelism
     p.add_argument("--mesh_data", type=int, default=-1)
@@ -225,6 +228,12 @@ def main(argv=None):
     opt = {"adam": optax.adam, "adamw": optax.adamw,
            "lamb": optax.lamb}[args.optimizer]
     tx = optax.chain(optax.clip_by_global_norm(args.grad_clip), opt(lr))
+    if args.grad_accum > 1:
+        # micro-batch accumulation: k steps of summed grads per optimizer
+        # update — effective batch k * batch_size without the memory.
+        # EMA/step bookkeeping stays per-micro-step (ema_decay applies at
+        # micro cadence, as with any MultiSteps wrapping).
+        tx = optax.MultiSteps(tx, every_k_schedule=args.grad_accum)
 
     null_cond = {}
     if encoder is not None:
