@@ -58,3 +58,8 @@ def test_video_audio_example():
 def test_ring_attention_example():
     hist = _run_example("07_ring_attention.py")
     assert np.isfinite(hist["final_loss"])
+
+
+def test_inpainting_example():
+    hist = _run_example("08_inpainting.py")
+    assert np.isfinite(hist["final_loss"])
