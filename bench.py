@@ -183,13 +183,38 @@ def bench_ddim_latency(image_size: int = 256, steps: int = 50,
     return sorted(times)[len(times) // 2]
 
 
+def probe_backend(timeout_s: int = 300):
+    """Touch the jax backend in a SUBPROCESS with a timeout first.
+
+    A wedged TPU tunnel hangs indefinitely at backend init (observed in
+    this build environment: jax.devices() blocks forever). Probing in a
+    child process converts an unbounded hang into a clear error so the
+    caller's run fails fast and diagnosable.
+    """
+    import subprocess
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(len(jax.devices()), jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        raise SystemExit(
+            f"bench: jax backend init did not complete within {timeout_s}s "
+            "(wedged TPU tunnel?); aborting instead of hanging")
+    if proc.returncode != 0:
+        raise SystemExit(f"bench: jax backend probe failed:\n{proc.stderr}")
+    log(f"backend probe: {proc.stdout.strip()}")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--trace", default=None,
                     help="capture a jax.profiler trace into this dir")
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--probe_timeout", type=int, default=300)
     args = ap.parse_args()
 
+    probe_backend(args.probe_timeout)
     import jax
     from flaxdiff_tpu.profiling import device_peak_flops, mfu, trace
 
