@@ -1,0 +1,139 @@
+"""Direct tests for the logging layer and the prefetch pipeline."""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flaxdiff_tpu.data.prefetch import prefetch_map
+from flaxdiff_tpu.trainer.logging import (JsonlLogger, MultiLogger,
+                                          make_logger, save_image_grid)
+
+
+class TestJsonlLogger:
+    def test_log_coerces_numpy_scalars(self, tmp_path):
+        lg = JsonlLogger(str(tmp_path / "log.jsonl"))
+        lg.log({"loss": np.float32(0.5), "count": np.int64(3),
+                "name": "run", "flag": True, "none": None,
+                "skipped_array": np.zeros(3)}, step=np.int32(7))
+        lg.finish()
+        rec = json.loads(open(tmp_path / "log.jsonl").read())
+        assert rec["loss"] == 0.5 and isinstance(rec["loss"], float)
+        assert rec["count"] == 3 and isinstance(rec["count"], int)
+        assert rec["step"] == 7
+        assert rec["name"] == "run" and rec["flag"] is True
+        assert rec["none"] is None
+        assert "skipped_array" not in rec   # non-scalars are dropped
+        assert "_time" in rec
+
+    def test_log_images_writes_png_and_reference(self, tmp_path):
+        lg = JsonlLogger(str(tmp_path / "log.jsonl"))
+        imgs = np.random.default_rng(0).uniform(
+            -1, 1, (5, 8, 8, 3)).astype(np.float32)
+        lg.log_images("val/samples", imgs, step=12)
+        lg.finish()
+        rec = json.loads(open(tmp_path / "log.jsonl").read())
+        png = rec["val/samples"]
+        assert png.endswith("val_samples_000012.png")
+        import cv2
+        grid = cv2.imread(png)
+        # 5 images -> 3x2 grid of 8px tiles with 2px pad
+        assert grid is not None and grid.shape == (18, 28, 3)
+
+    def test_log_images_failure_never_raises(self, tmp_path):
+        lg = JsonlLogger(str(tmp_path / "log.jsonl"))
+        lg.log_images("bad", np.zeros((2, 3)), step=0)   # wrong rank
+        lg.finish()
+        rec = json.loads(open(tmp_path / "log.jsonl").read())
+        assert "grid save failed" in rec["bad"]
+
+
+def test_save_image_grid_video_input(tmp_path):
+    vids = np.random.default_rng(0).integers(
+        0, 255, (2, 3, 8, 8, 3)).astype(np.uint8)
+    path = save_image_grid(vids, str(tmp_path / "g.png"))
+    import cv2
+    grid = cv2.imread(path)
+    # 6 frames -> 3x2 grid
+    assert grid.shape == (18, 28, 3)
+
+
+def test_make_logger_fallbacks(tmp_path):
+    lg = make_logger(jsonl_path=str(tmp_path / "a.jsonl"))
+    assert isinstance(lg, JsonlLogger)
+    lg.finish()
+    # wandb project + jsonl: wandb may be absent; never raises
+    lg = make_logger(project=None, jsonl_path=str(tmp_path / "b.jsonl"))
+    lg.log({"x": 1})
+    lg.finish()
+
+
+def test_multilogger_fans_out(tmp_path):
+    a = JsonlLogger(str(tmp_path / "a.jsonl"))
+    b = JsonlLogger(str(tmp_path / "b.jsonl"))
+    ml = MultiLogger([a, b])
+    ml.log({"v": 2}, step=1)
+    ml.finish()
+    for f in ("a.jsonl", "b.jsonl"):
+        assert json.loads(open(tmp_path / f).read())["v"] == 2
+
+
+class TestPrefetchMap:
+    def test_order_preserved(self):
+        out = list(prefetch_map(lambda x: x * 2, iter(range(20)), depth=3))
+        assert out == [x * 2 for x in range(20)]
+
+    def test_fn_exception_propagates(self):
+        def boom(x):
+            if x == 3:
+                raise RuntimeError("bad item")
+            return x
+
+        it = prefetch_map(boom, iter(range(10)), depth=2)
+        assert next(it) == 0
+        with pytest.raises(RuntimeError, match="bad item"):
+            list(it)
+
+    def test_source_exception_propagates(self):
+        def src():
+            yield 1
+            raise ValueError("source died")
+
+        it = prefetch_map(lambda x: x, src(), depth=2)
+        assert next(it) == 1
+        with pytest.raises(ValueError, match="source died"):
+            next(it)
+
+    def test_depth_validation(self):
+        with pytest.raises(ValueError, match="depth"):
+            list(prefetch_map(lambda x: x, iter([1]), depth=0))
+
+    def test_actually_overlaps(self):
+        """With depth 2, the producer works ahead while the consumer is
+        slow: total wall time approaches max(produce, consume), not the
+        sum."""
+        def slow_fn(x):
+            time.sleep(0.05)
+            return x
+
+        t0 = time.perf_counter()
+        for _ in prefetch_map(slow_fn, iter(range(8)), depth=4):
+            time.sleep(0.05)   # consumer work
+        dt = time.perf_counter() - t0
+        # serial would be ~0.8s; overlapped ~0.45s
+        assert dt < 0.7, dt
+
+    def test_tuple_items_pass_through(self):
+        """2-tuples from fn must not be mistaken for the sentinel."""
+        out = list(prefetch_map(lambda x: (x, x + 1), iter(range(4))))
+        assert out == [(0, 1), (1, 2), (2, 3), (3, 4)]
+
+    def test_worker_thread_terminates(self):
+        before = {t.name for t in threading.enumerate()}
+        list(prefetch_map(lambda x: x, iter(range(5))))
+        time.sleep(0.1)
+        after = [t for t in threading.enumerate()
+                 if t.name == "flaxdiff-prefetch" and t.is_alive()]
+        # the worker drains and exits once the source is exhausted
+        assert not after or all(not t.is_alive() for t in after), before
