@@ -15,7 +15,7 @@ from typing import Any, Callable, Dict, Iterator, Optional
 import jax
 import numpy as np
 
-from .sources.base import DataAugmenter, DataSource, MediaDataset
+from .sources.base import MediaDataset
 
 
 def collate(samples, sample_key: str = "image") -> Dict[str, Any]:

@@ -19,7 +19,7 @@ from typing import Any, Dict, Iterable, List, Optional
 
 import numpy as np
 
-from .sources.base import DataAugmenter, DataSource
+from .sources.base import DataSource
 
 MAGIC = b"FDTR"
 # v1: (offset u64, length u64) index entries, no checksums.

@@ -24,12 +24,12 @@ import functools
 import os
 import subprocess
 import tempfile
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
 from .base import DataAugmenter
-from .videos import VideoFolderSource, gather_video_paths
+from .videos import VideoFolderSource
 
 __all__ = [
     "video_fps", "video_frame_count", "video_duration",

@@ -18,7 +18,7 @@ import numpy as np
 from ..inputs import DiffusionInputConfig
 from ..predictors import TRANSFORM_REGISTRY, PredictionTransform
 from ..samplers import SAMPLER_REGISTRY, DiffusionSampler, Sampler
-from ..schedulers import SCHEDULE_REGISTRY, get_schedule
+from ..schedulers import get_schedule
 from ..utils import RngSeq
 from .registry import build_model
 

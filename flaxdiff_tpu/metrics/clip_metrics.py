@@ -6,7 +6,6 @@ similarity math is exposed as pure, weight-free functions.
 """
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

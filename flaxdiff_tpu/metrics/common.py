@@ -4,7 +4,7 @@ keeps per-metric best with higher_is_better)."""
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Optional
+from typing import Callable, Dict
 
 
 @dataclass

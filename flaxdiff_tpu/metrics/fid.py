@@ -9,10 +9,9 @@ FID-10k, or CLIP features for CLIP-FID).
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Iterable, Optional
+from typing import Callable, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import scipy.linalg
 

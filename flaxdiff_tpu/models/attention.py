@@ -8,7 +8,7 @@ first-party Pallas kernel in ops/flash_attention.py.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 import flax.linen as nn
 import jax

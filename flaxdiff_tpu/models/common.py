@@ -9,7 +9,7 @@ compute in f32 and cast back, and the resblock epilogue is fusable by XLA
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Sequence, Tuple, Union
+from typing import Callable, Optional, Tuple, Union
 
 import flax.linen as nn
 import jax

@@ -14,7 +14,6 @@ import math
 from typing import Dict, Optional, Sequence
 
 import jax
-import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 

@@ -16,15 +16,14 @@ schedule family instead).
 """
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, Optional, Sequence, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import flax.struct
 import jax
 import jax.numpy as jnp
 
 from ..predictors import PredictionTransform
-from ..schedulers.common import NoiseSchedule, SigmaSchedule, bcast_right
+from ..schedulers.common import NoiseSchedule, bcast_right
 from ..typing import PRNGKey
 from ..utils import RngSeq, clip_images
 

@@ -1,7 +1,6 @@
 """DDPM ancestral samplers (reference flaxdiff/samplers/ddpm.py:6-36)."""
 from __future__ import annotations
 
-from typing import Any
 
 import jax
 import jax.numpy as jnp

@@ -17,7 +17,6 @@ sigma-space Heun amplifies model error by |delta sigma_hat|.
 """
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 from .common import Sampler

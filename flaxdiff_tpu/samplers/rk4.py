@@ -6,7 +6,6 @@ on GeneralizedNoiseScheduler).
 """
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 from ..schedulers.common import SigmaSchedule, bcast_right

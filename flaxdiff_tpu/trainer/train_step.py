@@ -23,7 +23,6 @@ from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import optax
 
 from ..predictors import PredictionTransform
 from ..schedulers.common import NoiseSchedule, bcast_right

@@ -8,7 +8,7 @@ instead of manual per-device splitting.
 """
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence, Tuple
+from typing import Tuple
 
 import flax.struct
 import jax

@@ -1,6 +1,5 @@
 """Tests: sharded checkpoint save/restore/resume, validation, logging."""
 import json
-import os
 
 import jax
 import jax.numpy as jnp

@@ -9,7 +9,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-import pytest
 
 from flaxdiff_tpu.parallel import create_mesh
 from flaxdiff_tpu.predictors import EpsilonPredictionTransform

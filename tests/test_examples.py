@@ -6,7 +6,6 @@ them from rotting.
 """
 import importlib
 import os
-import sys
 
 import numpy as np
 import pytest

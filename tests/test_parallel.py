@@ -1,9 +1,8 @@
 """Parallel layer tests on the 8-device virtual CPU mesh."""
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from flaxdiff_tpu.parallel import (
     create_mesh,
@@ -11,7 +10,6 @@ from flaxdiff_tpu.parallel import (
     infer_fsdp_spec,
     match_partition_rules,
     shard_pytree,
-    sharding_tree,
 )
 from flaxdiff_tpu.parallel.mesh import batch_spec, mesh_shape_for
 

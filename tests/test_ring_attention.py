@@ -8,7 +8,6 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from flaxdiff_tpu.ops.attention import dot_product_attention
 from flaxdiff_tpu.parallel import create_mesh
 from flaxdiff_tpu.parallel.ring_attention import (
-    ring_attention_sharded,
     ring_self_attention,
     sequence_sharding,
 )

@@ -6,7 +6,6 @@ emit column/row-parallel specs for attention and MLP projections, GSPMD
 inserts the all-reduce at the row-parallel contraction, and a DiT must
 train with numerics matching a replicated run.
 """
-import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -15,7 +14,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from flaxdiff_tpu.models.dit import SimpleDiT
-from flaxdiff_tpu.parallel import create_mesh, fsdp_sharding_tree
+from flaxdiff_tpu.parallel import create_mesh
 from flaxdiff_tpu.parallel.partition import infer_tp_spec
 from flaxdiff_tpu.predictors import EpsilonPredictionTransform
 from flaxdiff_tpu.schedulers import CosineNoiseSchedule

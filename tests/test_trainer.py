@@ -8,8 +8,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-import pytest
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from flaxdiff_tpu.predictors import EpsilonPredictionTransform
 from flaxdiff_tpu.schedulers import CosineNoiseSchedule
