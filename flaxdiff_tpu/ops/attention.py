@@ -99,6 +99,27 @@ def _shard_mapped_flash(q: jax.Array, k: jax.Array, v: jax.Array,
     return fn(q, k, v)
 
 
+def _seq_parallel_gate(q: jax.Array, k: jax.Array,
+                       need_head_divisible: bool = False):
+    """(mesh, seq_axis) when sequence-parallel attention applies to these
+    shapes under the active mesh, else None. Shared by the "ring" and
+    "ulysses" dispatch branches so their gating can't drift apart."""
+    from ..parallel.context import (get_active_mesh, get_seq_axis,
+                                    seq_parallel_active)
+    mesh = get_active_mesh()
+    if not (seq_parallel_active() and q.shape[1] == k.shape[1]):
+        return None
+    seq_axis = get_seq_axis()
+    data_n = int(np.prod([mesh.shape[a] for a in mesh.axis_names
+                          if a == "data"])) if mesh else 1
+    n = mesh.shape[seq_axis]
+    if q.shape[1] % n != 0 or q.shape[0] % max(data_n, 1) != 0:
+        return None
+    if need_head_divisible and q.shape[2] % n != 0:
+        return None
+    return mesh, seq_axis
+
+
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           backend: str = "auto",
                           scale: Optional[float] = None,
@@ -107,6 +128,8 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     backend: "flash" (Pallas TPU kernel), "xla", "ring" (sequence-parallel
     ring attention over the active mesh's seq axis — self-attention only),
+    "ulysses" (all-to-all sequence parallelism: one re-shard each way,
+    exact local attention; needs heads AND seq divisible by the seq axis),
     "performer" (FAVOR+ linear attention, O(L) approximate), or "auto"
     (flash on TPU when shapes qualify, else xla).
     """
@@ -116,26 +139,26 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         # force_fp32_for_softmax has no meaning here; scale is honored.
         from .linear_attention import favor_attention
         return favor_attention(q, k, v, scale=scale)
-    if backend == "ring":
-        from ..parallel.context import (get_active_mesh, get_seq_axis,
-                                        seq_parallel_active)
-        # Ring attention needs: a declared mesh with a real seq axis;
-        # equal q/kv sequence lengths (the heuristic separating
+    if backend in ("ring", "ulysses"):
+        # Shared sequence-parallel gate: a declared mesh with a real seq
+        # axis; equal q/kv sequence lengths (the heuristic separating
         # self-attention from cross-attention's short unsharded kv); and
-        # shapes that shard evenly — seq divisible by the seq axis, batch
-        # by the data axes. Anything else degrades to "auto" so the model
+        # shapes that shard evenly — seq divisible by the seq axis,
+        # batch by the data axes; Ulysses additionally needs whole heads
+        # per device. Anything else degrades to "auto" so the model
         # definition stays valid on single-chip, on CPU tests, and at
-        # levels whose token counts don't tile the ring.
-        mesh = get_active_mesh()
-        if seq_parallel_active() and q.shape[1] == k.shape[1]:
-            seq_axis = get_seq_axis()
-            data_n = int(np.prod([mesh.shape[a] for a in mesh.axis_names
-                                  if a == "data"])) if mesh else 1
-            if (q.shape[1] % mesh.shape[seq_axis] == 0
-                    and q.shape[0] % max(data_n, 1) == 0):
-                from ..parallel.ring_attention import ring_self_attention
-                return ring_self_attention(
+        # levels whose token/head counts don't tile the mesh.
+        gate = _seq_parallel_gate(q, k, need_head_divisible=(
+            backend == "ulysses"))
+        if gate is not None:
+            mesh, seq_axis = gate
+            if backend == "ulysses":
+                from ..parallel.ulysses import ulysses_self_attention
+                return ulysses_self_attention(
                     q, k, v, mesh, seq_axis=seq_axis, scale=scale)
+            from ..parallel.ring_attention import ring_self_attention
+            return ring_self_attention(
+                q, k, v, mesh, seq_axis=seq_axis, scale=scale)
         backend = "auto"
     use_flash = False
     if backend in ("auto", "flash") and attention_backend_available("flash"):

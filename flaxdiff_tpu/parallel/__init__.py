@@ -21,6 +21,10 @@ from .ring_attention import (
     ring_self_attention,
     sequence_sharding,
 )
+from .ulysses import (
+    ulysses_attention_sharded,
+    ulysses_self_attention,
+)
 from .partition import (
     PartitionRule,
     fsdp_sharding_tree,
@@ -41,6 +45,8 @@ __all__ = [
     "use_mesh",
     "ring_attention_sharded",
     "ring_self_attention",
+    "ulysses_attention_sharded",
+    "ulysses_self_attention",
     "sequence_sharding",
     "local_batch_size",
     "mesh_shape_for",

@@ -80,6 +80,15 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.astype(q.dtype)
 
 
+def seq_shard_spec(mesh: Mesh, seq_axis: str = "seq",
+                   batch_axes: Tuple[str, ...] = ("data",)) -> P:
+    """PartitionSpec for [B, S, H, D] with S on the seq axis (shared by
+    the ring and Ulysses shard_map wrappers)."""
+    b_spec = tuple(a for a in batch_axes if a in mesh.axis_names)
+    b = b_spec if len(b_spec) != 1 else b_spec[0]
+    return P(b if b_spec else None, seq_axis, None, None)
+
+
 def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         mesh: Mesh, seq_axis: str = "seq",
                         batch_axes: Tuple[str, ...] = ("data",),
@@ -87,9 +96,7 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """Top-level entry: [B, S, H, D] arrays, S sharded over `seq_axis`,
     B over `batch_axes`. Wraps `ring_attention_sharded` in shard_map so
     XLA SPMD emits the ppermute ring over ICI."""
-    b_spec = tuple(a for a in batch_axes if a in mesh.axis_names)
-    b = b_spec if len(b_spec) != 1 else b_spec[0]
-    spec = P(b if b_spec else None, seq_axis, None, None)
+    spec = seq_shard_spec(mesh, seq_axis, batch_axes)
     fn = shard_map(
         functools.partial(ring_attention_sharded, axis_name=seq_axis,
                           scale=scale),
