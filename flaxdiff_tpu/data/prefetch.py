@@ -29,28 +29,49 @@ def prefetch_map(fn: Callable[[T], U], it: Iterator[T],
     """Apply `fn` to items of `it` in a daemon thread, keeping up to
     `depth` results ready. Order-preserving. Exceptions in `fn` or the
     source iterator re-raise at the consumer's next() (the data-layer
-    fault-surfacing behavior of reference online_loader.py:980-988)."""
+    fault-surfacing behavior of reference online_loader.py:980-988).
+
+    Closing/abandoning the returned generator stops the worker: its
+    queue puts poll a stop flag, so a consumer that walks away (common
+    in tests and chunked training loops) doesn't leave a thread blocked
+    on a full queue for the life of the process."""
     if depth < 1:
         raise ValueError("depth must be >= 1")
     q: "queue.Queue" = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        """Blocking put that gives up when the consumer is gone."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def worker():
         try:
             for item in it:
-                q.put(fn(item))
+                if not put(fn(item)):
+                    return
         except BaseException as e:  # surfaced on the consumer side
-            q.put((_SENTINEL, e))
+            put((_SENTINEL, e))
             return
-        q.put((_SENTINEL, None))
+        put((_SENTINEL, None))
 
     t = threading.Thread(target=worker, daemon=True,
                          name="flaxdiff-prefetch")
     t.start()
 
-    while True:
-        got = q.get()
-        if isinstance(got, tuple) and len(got) == 2 and got[0] is _SENTINEL:
-            if got[1] is not None:
-                raise got[1]
-            return
-        yield got
+    try:
+        while True:
+            got = q.get()
+            if isinstance(got, tuple) and len(got) == 2 \
+                    and got[0] is _SENTINEL:
+                if got[1] is not None:
+                    raise got[1]
+                return
+            yield got
+    finally:
+        stop.set()

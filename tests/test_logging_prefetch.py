@@ -129,11 +129,28 @@ class TestPrefetchMap:
         out = list(prefetch_map(lambda x: (x, x + 1), iter(range(4))))
         assert out == [(0, 1), (1, 2), (2, 3), (3, 4)]
 
+    @staticmethod
+    def _live_workers():
+        return {t for t in threading.enumerate()
+                if t.name == "flaxdiff-prefetch" and t.is_alive()}
+
+    def _assert_no_new_workers(self, before, timeout=3.0):
+        deadline = time.time() + timeout
+        while self._live_workers() - before and time.time() < deadline:
+            time.sleep(0.05)
+        leaked = self._live_workers() - before
+        assert not leaked, leaked
+
     def test_worker_thread_terminates(self):
-        before = {t.name for t in threading.enumerate()}
+        before = self._live_workers()
         list(prefetch_map(lambda x: x, iter(range(5))))
-        time.sleep(0.1)
-        after = [t for t in threading.enumerate()
-                 if t.name == "flaxdiff-prefetch" and t.is_alive()]
-        # the worker drains and exits once the source is exhausted
-        assert not after or all(not t.is_alive() for t in after), before
+        self._assert_no_new_workers(before)
+
+    def test_abandoned_iterator_stops_worker(self):
+        """A consumer that walks away mid-stream must not leave the
+        worker blocked on the full queue forever."""
+        before = self._live_workers()
+        it = prefetch_map(lambda x: x, iter(range(1000)), depth=2)
+        assert next(it) == 0
+        it.close()   # generator finalizer sets the stop flag
+        self._assert_no_new_workers(before)
