@@ -192,10 +192,17 @@ def probe_backend(timeout_s: int = 300):
     caller's run fails fast and diagnosable.
     """
     import subprocess
+    # honor JAX_PLATFORMS inside the child: a site hook may have latched a
+    # different platform at interpreter startup (same workaround as
+    # tests/conftest.py), so the env var must be re-applied via config
+    probe_src = (
+        "import os, jax\n"
+        "p = os.environ.get('JAX_PLATFORMS')\n"
+        "if p: jax.config.update('jax_platforms', p)\n"
+        "print(len(jax.devices()), jax.devices()[0].platform)\n")
     try:
         proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(len(jax.devices()), jax.devices()[0].platform)"],
+            [sys.executable, "-c", probe_src],
             capture_output=True, text=True, timeout=timeout_s)
     except subprocess.TimeoutExpired:
         raise SystemExit(
