@@ -165,11 +165,14 @@ class PackedRecordReader:
                                             total, lengths))
         if wrote != total:
             raise IOError(f"batch read failed ({wrote} != {total} bytes)")
-        raw = buf.raw  # one materialization; .raw copies on every access
+        # Slice each record straight out of a memoryview of the ctypes
+        # buffer: .raw would materialize a second full-buffer copy before
+        # slicing, halving the benefit of the batched native read.
+        mv = memoryview(buf)
         out, pos = [], 0
         for i in range(n):
             ln = int(lengths[i])
-            out.append(raw[pos:pos + ln])
+            out.append(bytes(mv[pos:pos + ln]))
             pos += ln
         return out
 

@@ -222,18 +222,23 @@ def main(argv=None):
     def init_fn(key):
         return model.init(key, x0, t0, c0)
 
-    # optimizer (reference training.py:594-608)
+    # optimizer (reference training.py:594-608). MultiSteps advances the
+    # inner schedule once per k micro-batches, so with --grad_accum the
+    # horizons are scaled by k to keep warmup/decay aligned with the
+    # total_steps micro-steps the fit loop actually runs.
+    accum = max(args.grad_accum, 1)
     lr = optax.warmup_cosine_decay_schedule(
-        0.0, args.lr, args.warmup_steps, max(args.total_steps, 1))
+        0.0, args.lr, max(args.warmup_steps // accum, 1),
+        max(args.total_steps // accum, 1))
     opt = {"adam": optax.adam, "adamw": optax.adamw,
            "lamb": optax.lamb}[args.optimizer]
     tx = optax.chain(optax.clip_by_global_norm(args.grad_clip), opt(lr))
-    if args.grad_accum > 1:
+    if accum > 1:
         # micro-batch accumulation: k steps of summed grads per optimizer
         # update — effective batch k * batch_size without the memory.
         # EMA/step bookkeeping stays per-micro-step (ema_decay applies at
         # micro cadence, as with any MultiSteps wrapping).
-        tx = optax.MultiSteps(tx, every_k_schedule=args.grad_accum)
+        tx = optax.MultiSteps(tx, every_k_schedule=accum)
 
     null_cond = {}
     if encoder is not None:
