@@ -139,6 +139,20 @@ class DiffusionTrainer:
                     self._step, self.state, batch)
         return self._step_flops[key]
 
+    def step_model_flops(self, global_batch: PyTree) -> Optional[float]:
+        """Analytic per-STEP matmul+conv FLOPs at true shapes (jaxpr walk,
+        no compile, no device work) — the unpadded "model FLOPs" MFU
+        numerator. This is the whole-mesh count (the jaxpr is traced
+        pre-partitioning); divide by device count for a per-chip figure.
+        Meaningful only when the model's attention backend is visible to
+        tracing ("xla"): pallas_call bodies are opaque, so a flash-backend
+        trainer undercounts — build an xla-backend twin for counting."""
+        from ..parallel.context import use_mesh
+        from ..profiling import traced_model_flops
+        batch = self._numeric_subtree(global_batch)
+        with use_mesh(self.mesh):
+            return traced_model_flops(self._step, self.state, batch)
+
     # -- checkpointing -------------------------------------------------------
     def save_checkpoint(self, force: bool = False) -> bool:
         """Sharded async save of the live state (+best_loss meta)."""
@@ -342,6 +356,11 @@ class DiffusionTrainer:
                     else:
                         self.save_checkpoint()
 
+            # Final force-save runs BEFORE the handler restore in `finally`:
+            # a second SIGTERM arriving during this save — the exact window
+            # preemption handling exists to protect — must hit _on_term (a
+            # harmless re-mark of stop["flag"]), not the default action.
+            self.save_checkpoint(force=True)
         finally:
             if profile_ctx is not None:
                 # sync before closing so async-dispatched steps' device
@@ -354,7 +373,6 @@ class DiffusionTrainer:
                 signal.signal(signal.SIGTERM,
                               prev_handler if prev_handler is not None
                               else signal.SIG_DFL)
-        self.save_checkpoint(force=True)
         history["final_loss"] = losses[-1] if losses else float("nan")
         history["best_loss"] = self.best_loss
         return history
