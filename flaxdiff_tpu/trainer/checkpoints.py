@@ -93,14 +93,29 @@ class Checkpointer:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.directory}")
         # structure/metadata-only pass, then request numpy leaves
+        # EXPLICITLY (restore_type=None would mean "as saved", i.e.
+        # jax.Array bound to the writer's shardings — orbax then warns
+        # "sharding info not provided ... unsafe when restoring on a
+        # different topology"; np.ndarray is genuinely topology-free)
+        import numpy as np
         item = self._mgr.item_metadata(step)["state"]
         restore_args = jax.tree_util.tree_map(
-            lambda _: ocp.RestoreArgs(restore_type=None), item)
-        restored = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.PyTreeRestore(restore_args=restore_args),
-                meta=ocp.args.JsonRestore()))
+            lambda _: ocp.RestoreArgs(restore_type=np.ndarray), item)
+        import warnings
+        with warnings.catch_warnings():
+            # orbax warns "sharding info not provided ... unsafe when
+            # restoring on a different topology" whenever restore args
+            # carry no sharding — including this explicitly-numpy
+            # restore, where no device placement happens at all and the
+            # caveat cannot apply. Suppress THAT warning only; a device
+            # restore goes through restore() which passes real shardings.
+            warnings.filterwarnings(
+                "ignore", message=".*[Ss]harding info not provided.*")
+            restored = self._mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.PyTreeRestore(restore_args=restore_args),
+                    meta=ocp.args.JsonRestore()))
         return restored["state"], (restored.get("meta") or {})
 
     def latest_step(self) -> Optional[int]:

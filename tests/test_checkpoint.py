@@ -108,6 +108,52 @@ def test_restore_arms_best_state(mesh, tmp_path, rng):
     trainer2.checkpointer.close()
 
 
+def test_cross_mesh_restore(mesh, tmp_path, rng):
+    """A checkpoint written from a (data=2, fsdp=4) mesh restores (a)
+    topology-free to host numpy with NO orbax sharding warning, and (b)
+    onto a DIFFERENT mesh shape via an abstract tree carrying the new
+    shardings (VERDICT r2 weak #6)."""
+    import warnings
+
+    from flaxdiff_tpu.parallel import create_mesh
+    from flaxdiff_tpu.trainer.checkpoints import abstract_state_like
+
+    trainer = _make_trainer(mesh, tmp_path)
+    it = _batches(3, rng)
+    for _ in range(2):
+        trainer.train_step(trainer.put_batch(next(it)))
+    assert trainer.save_checkpoint(force=True)
+    trainer.checkpointer.wait_until_finished()
+    want = jax.device_get(trainer.state.params)
+
+    # (a) host restore: numpy leaves, no different-topology warning
+    ck = Checkpointer(str(tmp_path))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        state, _ = ck.restore_to_host()
+    topo = [w for w in caught if "topolog" in str(w.message).lower()
+            or "sharding info not provided" in str(w.message).lower()]
+    assert not topo, [str(w.message) for w in topo]
+    got = state["params"]
+    jax.tree_util.tree_map(np.testing.assert_allclose, want,
+                           jax.tree_util.tree_map(np.asarray, got))
+    ck.close()
+
+    # (b) resharded restore onto a different mesh (1-D all-data)
+    other = _make_trainer(create_mesh(axes={"data": -1}), None)
+    ck = Checkpointer(str(tmp_path))
+    abstract = abstract_state_like(other.state)
+    restored, _ = ck.restore(abstract)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a),
+                                                np.asarray(b)),
+        want, jax.device_get(restored.params))
+    # leaves landed with the NEW mesh's shardings
+    leaf = jax.tree_util.tree_leaves(restored.params)[0]
+    assert leaf.sharding.mesh.shape == {"data": 8}
+    ck.close()
+
+
 def test_restore_without_checkpoint_raises(mesh, tmp_path):
     trainer = _make_trainer(mesh, tmp_path / "empty")
     with pytest.raises(FileNotFoundError):
