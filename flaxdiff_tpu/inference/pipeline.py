@@ -100,6 +100,35 @@ class DiffusionInferencePipeline:
             best["checkpoint_dir"], step=step, autoencoder=autoencoder)
 
     @staticmethod
+    def from_wandb_run(run_path: str,
+                       artifact: Optional[str] = None,
+                       cache_dir: Optional[str] = None,
+                       autoencoder=None) -> "DiffusionInferencePipeline":
+        """Rebuild a pipeline from a wandb run's logged model artifact
+        (reference inference/pipeline.py:59-147 from_wandb_run).
+
+        `run_path` is "entity/project/run_id". The artifact directory is
+        the checkpoint directory push_artifact uploaded — including
+        pipeline_config.json — so this is a thin layer over
+        from_checkpoint. `artifact` selects a specific "name:alias";
+        default is the run's most recent model-type artifact."""
+        import wandb
+        api = wandb.Api()
+        run = api.run(run_path)
+        if artifact is not None:
+            art = api.artifact(artifact, type="model")
+        else:
+            arts = [a for a in run.logged_artifacts()
+                    if getattr(a, "type", None) == "model"]
+            if not arts:
+                raise FileNotFoundError(
+                    f"run {run_path} logged no model artifacts")
+            art = arts[-1]
+        local = art.download(root=cache_dir)
+        return DiffusionInferencePipeline.from_checkpoint(
+            local, autoencoder=autoencoder)
+
+    @staticmethod
     def from_checkpoint(checkpoint_dir: str,
                         step: Optional[int] = None,
                         autoencoder=None) -> "DiffusionInferencePipeline":

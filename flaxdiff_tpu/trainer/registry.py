@@ -116,9 +116,48 @@ class ModelRegistry:
     def runs(self) -> Dict[str, Any]:
         return dict(self._data["runs"])
 
+    def top_k(self, metric: str, k: int = 5):
+        """Ranked top-k runs for `metric` with their run metadata — the
+        reference compares a finishing run against the sweep/project's
+        historical top-k (general_diffusion_trainer.py:596-703).
+        Direction-aware via the persisted metric directions."""
+        hib = bool(self._data.get("directions", {}).get(metric, False))
+        ranked = []
+        for name, run in self._data["runs"].items():
+            if metric in run.get("metrics", {}):
+                ranked.append({
+                    "run": name,
+                    "value": float(run["metrics"][metric]),
+                    "step": int(run.get("step", 0)),
+                    "checkpoint_dir": run.get("checkpoint_dir"),
+                    "config": run.get("config"),
+                    "higher_is_better": hib,
+                })
+        ranked.sort(key=lambda r: r["value"], reverse=hib)
+        return ranked[:k]
+
     def best_run(self, metric: str) -> Optional[Dict[str, Any]]:
         return self._data["best"].get(metric)
 
     def best_checkpoint(self, metric: str) -> Optional[str]:
         best = self.best_run(metric)
         return best["checkpoint_dir"] if best else None
+
+
+def pull_artifact(name: str, target_dir: str,
+                  alias: str = "latest") -> Optional[str]:
+    """Download the model artifact `name` into `target_dir` from the
+    ACTIVE wandb run's project — the resume half of push_artifact
+    (reference simple_trainer.py:194-211: on wandb run resume, the logged
+    model artifact is auto-downloaded and training restores from it).
+    Returns the local directory, or None when wandb is unavailable, no
+    run is active, or no such artifact exists."""
+    try:
+        import wandb
+        if wandb.run is None:
+            return None
+        art = wandb.run.use_artifact(
+            f"{name.replace('/', '_')}:{alias}", type="model")
+        return art.download(root=target_dir)
+    except Exception:
+        return None

@@ -65,3 +65,27 @@ def test_cli_writes_registry(tmp_path):
     reg = ModelRegistry(str(tmp_path / "runs" / "registry.json"))
     assert "exp1" in reg.runs()
     assert reg.best_run("loss")["run"] == "exp1"
+
+
+def test_registry_top_k_ranked(tmp_path):
+    """Ranked top-k per metric with run metadata (reference compares
+    against sweep-history top-k, general_diffusion_trainer.py:596-703)."""
+    from flaxdiff_tpu.trainer import ModelRegistry
+    reg = ModelRegistry(str(tmp_path / "registry.json"))
+    for i, loss in enumerate([0.5, 0.2, 0.9, 0.4]):
+        reg.register_run(f"run{i}", checkpoint_dir=f"/ck/{i}", step=10 + i,
+                         metrics={"loss": loss, "clip_score": 1 - loss},
+                         metric_directions={"loss": False,
+                                            "clip_score": True},
+                         config={"arch": f"a{i}"})
+    top = reg.top_k("loss", k=3)
+    assert [r["run"] for r in top] == ["run1", "run3", "run0"]
+    assert top[0]["value"] == 0.2 and top[0]["config"] == {"arch": "a1"}
+    assert all(not r["higher_is_better"] for r in top)
+    top_cs = reg.top_k("clip_score", k=2)
+    assert [r["run"] for r in top_cs] == ["run1", "run3"]
+    assert all(r["higher_is_better"] for r in top_cs)
+    # persisted: a fresh instance ranks identically
+    reg2 = ModelRegistry(str(tmp_path / "registry.json"))
+    assert [r["run"] for r in reg2.top_k("loss")] == \
+        ["run1", "run3", "run0", "run2"]

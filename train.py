@@ -88,6 +88,11 @@ def parse_args(argv=None):
                         "(standard FID; random features otherwise)")
     p.add_argument("--sampler", default="euler_ancestral")
     p.add_argument("--wandb_project", default=None)
+    p.add_argument("--wandb_resume", default=None, metavar="RUN_ID",
+                   help="resume this wandb run id; its logged model "
+                        "artifact is auto-downloaded when no local "
+                        "checkpoint exists (reference "
+                        "simple_trainer.py:194-211)")
     p.add_argument("--registry", default=None,
                    help="path to registry.json for cross-run best tracking "
                         "(default: <checkpoint_dir>/../registry.json)")
@@ -227,9 +232,11 @@ def main(argv=None):
     # horizons are scaled by k to keep warmup/decay aligned with the
     # total_steps micro-steps the fit loop actually runs.
     accum = max(args.grad_accum, 1)
+    warmup = max(args.warmup_steps // accum, 1)
+    # optax requires decay_steps > warmup_steps; short runs (resumes,
+    # smoke tests) may configure total <= warmup
     lr = optax.warmup_cosine_decay_schedule(
-        0.0, args.lr, max(args.warmup_steps // accum, 1),
-        max(args.total_steps // accum, 1))
+        0.0, args.lr, warmup, max(args.total_steps // accum, warmup + 1))
     opt = {"adam": optax.adam, "adamw": optax.adamw,
            "lamb": optax.lamb}[args.optimizer]
     tx = optax.chain(optax.clip_by_global_norm(args.grad_clip), opt(lr))
@@ -255,6 +262,51 @@ def main(argv=None):
         from flaxdiff_tpu.typing import Policy
         policy = Policy(compute_dtype=jnp.float16)
 
+    # The one name shared by the resume-pull and end-of-run push+registry
+    # record: the two sites must never drift or resume stops finding the
+    # pushed artifact.
+    run_name = args.run_name or os.path.basename(
+        os.path.normpath(args.checkpoint_dir))
+
+    # Logger before checkpointer: wandb-run resume must be live so the
+    # model artifact can be pulled back BEFORE restore looks at disk.
+    os.makedirs(args.checkpoint_dir, exist_ok=True)
+    wandb_kwargs = ({"id": args.wandb_resume, "resume": "must"}
+                    if args.wandb_resume else {})
+    logger = make_logger(project=args.wandb_project,
+                         jsonl_path=os.path.join(args.checkpoint_dir,
+                                                 "train_log.jsonl"),
+                         **wandb_kwargs)
+    if args.wandb_resume:
+        has_local = any(d.isdigit()
+                        for d in os.listdir(args.checkpoint_dir))
+        if not has_local:
+            # Process 0 downloads into the shared checkpoint_dir; the
+            # others wait at the barrier (concurrent downloads into one
+            # directory can corrupt the orbax step layout).
+            pulled = None
+            if jax.process_index() == 0:
+                from flaxdiff_tpu.trainer.registry import pull_artifact
+                pulled = pull_artifact(run_name, args.checkpoint_dir)
+                if pulled:
+                    print(f"pulled wandb artifact {run_name} -> {pulled}")
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices("wandb_artifact_pull")
+            has_local = any(d.isdigit()
+                            for d in os.listdir(args.checkpoint_dir))
+            if not has_local:
+                # --wandb_resume is an explicit promise of prior state;
+                # silently restarting from step 0 would also re-alias
+                # "latest" to a from-scratch checkpoint at the end of the
+                # run, clobbering the only copy of the real progress.
+                raise SystemExit(
+                    f"--wandb_resume {args.wandb_resume}: no local "
+                    f"checkpoint under {args.checkpoint_dir} and the "
+                    f"model artifact {run_name!r} could not be pulled "
+                    "(no active wandb run / artifact missing / download "
+                    "failed)")
+
     ckpt = Checkpointer(args.checkpoint_dir)
     trainer = DiffusionTrainer(
         apply_fn=apply_fn, init_fn=init_fn, tx=tx, schedule=schedule,
@@ -276,10 +328,6 @@ def main(argv=None):
         "predictor": args.predictor,
         "input_config": (input_config.serialize() if conditions else None),
     })
-
-    logger = make_logger(project=args.wandb_project,
-                         jsonl_path=os.path.join(args.checkpoint_dir,
-                                                 "train_log.jsonl"))
 
     validator = None
     if args.val_every:
@@ -368,6 +416,11 @@ def main(argv=None):
                               step=done)
     logger.log({"final_loss": hist["final_loss"]}, step=done)
 
+    # The final save is ASYNC: it must be fully on disk before the
+    # registry records it and push_artifact copies the directory — an
+    # unfinalized step would upload a partial checkpoint.
+    ckpt.wait_until_finished()
+
     # registry: record the run + per-metric best across runs; push a
     # wandb artifact when a run is live (reference
     # general_diffusion_trainer.py:560-727). Process 0 only — every host
@@ -389,8 +442,6 @@ def main(argv=None):
             if m.name in validator.tracker.best:
                 final_metrics[m.name] = validator.tracker.best[m.name]
                 directions[m.name] = m.higher_is_better
-    run_name = args.run_name or os.path.basename(
-        os.path.normpath(args.checkpoint_dir))
     became_best = registry.register_run(
         run_name, checkpoint_dir=args.checkpoint_dir, step=done,
         metrics=final_metrics, metric_directions=directions,
