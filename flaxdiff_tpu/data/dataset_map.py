@@ -81,6 +81,25 @@ def _av_folder(root: str, image_size: int = 64, num_frames: int = 16,
         media_type="audiovideo")
 
 
+@register_dataset("packed_shards")
+def _packed_shards(pattern: str = None, root: str = None,
+                   image_size: int = 64, filesystem=None,
+                   max_open: int = 16, **kwargs) -> MediaDataset:
+    """Sharded packed-record corpus — the at-scale entry shape of the
+    reference's GCS ArrayRecord tables (reference dataset_map.py:19-105:
+    hundreds of shards, 20M+ samples, fuse-mounted bucket). `pattern`
+    globs the shard files (`root` is the CLI --dataset_path alias);
+    `filesystem` swaps in a remote FS object (open/glob) for stores
+    that cannot mmap."""
+    from .sharded_source import ShardedPackedRecordSource
+    return MediaDataset(
+        source=ShardedPackedRecordSource(pattern=pattern or root,
+                                         filesystem=filesystem,
+                                         max_open=max_open),
+        augmenter=ImageAugmenter(image_size=image_size),
+        media_type="image")
+
+
 @register_dataset("voxceleb2_local")
 def _voxceleb2(root: str, image_size: int = 64, num_frames: int = 16,
                with_mel: bool = True, with_face_mask: bool = True,

@@ -1,0 +1,225 @@
+"""Sharded packed-record corpora over a filesystem abstraction.
+
+The reference's at-scale path is pygrain.ArrayRecordDataSource over
+hundreds of ArrayRecord shards on a gcsfuse-mounted bucket (reference
+data/sources/images.py:219-270; data/dataset_map.py:19-105 — e.g.
+combined_msml612: 883 GiB / 20M+ samples across 569+ shards). This is
+the first-party analogue: many packed-record shard files presented as
+ONE indexable source, so grain's IndexSampler + ShardByJaxProcess hands
+each process a disjoint slice of the global record space exactly as the
+reference's corpus table does.
+
+Two read paths:
+  - local paths (incl. fuse mounts, the reference's actual GCS access
+    mode): the native mmap reader (data/packed_records.py);
+  - any `FileSystem`-shaped object (open/glob): a pure-Python seek/read
+    reader — the mockable remote path for object stores that cannot
+    mmap. Tests drive it with an in-memory FS standing in for a bucket.
+
+Shards open LAZILY and an LRU bound caps simultaneously-open readers:
+a 20M-record epoch touches shards as the sampler reaches them instead
+of holding 569 file handles/mmaps from startup.
+"""
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import glob as _glob
+import struct
+import threading
+import zlib
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence
+
+from .sources.base import DataSource
+
+_HEADER = struct.Struct("<4sIQ")          # magic, version, n_records
+_INDEX_V2 = struct.Struct("<QQII")        # offset, length, crc32, pad
+_INDEX_V1 = struct.Struct("<QQ")
+
+
+class LocalFileSystem:
+    """Default FileSystem: plain local (or fuse-mounted) paths."""
+
+    def open(self, path: str, mode: str = "rb"):
+        return open(path, mode)
+
+    def glob(self, pattern: str) -> List[str]:
+        return sorted(_glob.glob(pattern))
+
+
+class PythonPackedReader:
+    """Pure-Python packed-record reader over a FileSystem file object —
+    the remote-capable counterpart of the native mmap reader (same v1/v2
+    layout as data/packed_records.py). Header+index are read once; each
+    record is one seek+read."""
+
+    def __init__(self, fs, path: str):
+        self._fs = fs
+        self._path = path
+        self._fh = fs.open(path, "rb")
+        self._lock = threading.Lock()   # grain read threads share readers
+        head = self._fh.read(_HEADER.size)
+        magic, version, n = _HEADER.unpack(head)
+        if magic != b"FDTR":
+            raise IOError(f"{path!r} is not a packed record file")
+        if version not in (1, 2):
+            raise IOError(f"{path!r}: unsupported version {version}")
+        self.version = version
+        entry = _INDEX_V2 if version == 2 else _INDEX_V1
+        raw = self._fh.read(entry.size * n)
+        self._index = [entry.unpack_from(raw, i * entry.size)
+                       for i in range(n)]
+        self._base = _HEADER.size + entry.size * n
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def record_bytes(self, idx: int) -> bytes:
+        off, length = self._index[idx][0], self._index[idx][1]
+        with self._lock:
+            self._fh.seek(self._base + off)
+            data = self._fh.read(length)
+        if len(data) != length:
+            raise IOError(f"short read at record {idx} of {self._path!r}")
+        return data
+
+    def verify(self, idx: int) -> bool:
+        if self.version < 2:
+            return True
+        return (zlib.crc32(self.record_bytes(idx)) & 0xFFFFFFFF) \
+            == self._index[idx][2]
+
+    def close(self):
+        self._fh.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+@dataclasses.dataclass
+class ShardedPackedRecordSource(DataSource):
+    """One global random-access index over many packed-record shards.
+
+    `shards`: explicit paths, or a glob `pattern` resolved through the
+    filesystem. `filesystem=None` uses the native mmap reader on local
+    paths; any FileSystem object switches every shard to the Python
+    seek/read path. `max_open` bounds concurrently-open shard readers
+    (LRU eviction)."""
+
+    shards: Optional[Sequence[str]] = None
+    pattern: Optional[str] = None
+    filesystem: Optional[Any] = None
+    max_open: int = 16
+    decode: bool = True
+
+    def __post_init__(self):
+        fs = self.filesystem or LocalFileSystem()
+        paths = list(self.shards) if self.shards else fs.glob(self.pattern)
+        if not paths:
+            raise FileNotFoundError(
+                f"no packed-record shards match {self.pattern!r}")
+        self._paths = paths
+        # per-shard record counts from the 16-byte HEADER alone (at the
+        # 569-shard / 20M-record target shape, parsing every shard's full
+        # index at startup would read hundreds of MB serially)
+        counts = [self._record_count(fs, p) for p in paths]
+        self._starts: List[int] = []
+        total = 0
+        for c in counts:
+            self._starts.append(total)
+            total += c
+        self._total = total
+        self._readers: OrderedDict[str, Any] = OrderedDict()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _record_count(fs, path: str) -> int:
+        with fs.open(path, "rb") as f:
+            head = f.read(_HEADER.size)
+        magic, version, n = _HEADER.unpack(head)
+        if magic != b"FDTR":
+            raise IOError(f"{path!r} is not a packed record file")
+        if version not in (1, 2):
+            raise IOError(f"{path!r}: unsupported version {version}")
+        return n
+
+    # grain worker processes pickle the data source: drop the lock and
+    # the warm reader cache (each worker re-opens shards lazily)
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_readers"] = OrderedDict()
+        state["_lock"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
+    def _open_reader(self, path: str):
+        if self.filesystem is None:
+            from .packed_records import PackedRecordReader
+            try:
+                return PackedRecordReader(path)
+            except Exception:
+                # native lib unavailable (unbuilt wheel): python fallback
+                return PythonPackedReader(LocalFileSystem(), path)
+        return PythonPackedReader(self.filesystem, path)
+
+    def _reader(self, path: str):
+        with self._lock:
+            r = self._readers.get(path)
+            if r is not None:
+                self._readers.move_to_end(path)
+                return r
+        r = self._open_reader(path)
+        with self._lock:
+            if path in self._readers:       # lost a race: keep the winner
+                r.close()
+                return self._readers[path]
+            self._readers[path] = r
+            while len(self._readers) > self.max_open:
+                # DROP the evicted reader, don't close() it: another grain
+                # read thread may hold it mid-record_bytes (close would
+                # be an I/O-on-closed-file error on the python path and a
+                # munmap use-after-free on the native one). Its __del__
+                # closes it once the last in-flight user releases it.
+                self._readers.popitem(last=False)
+        return r
+
+    def locate(self, i: int):
+        """Global record index -> (shard_path, local_index)."""
+        if not 0 <= i < self._total:
+            raise IndexError(f"record {i} out of range (n={self._total})")
+        s = bisect.bisect_right(self._starts, i) - 1
+        return self._paths[s], i - self._starts[s]
+
+    def get_source(self, path_override: Optional[str] = None):
+        if path_override:
+            return dataclasses.replace(
+                self, shards=None, pattern=path_override).get_source()
+        outer = self
+
+        class _Src:
+            def __len__(self):
+                return outer._total
+
+            def __getitem__(self, i):
+                path, local = outer.locate(int(i))
+                from .packed_records import unpack_record
+                entries = unpack_record(
+                    outer._reader(path).record_bytes(local))
+                if not outer.decode:
+                    return entries
+                rec: Dict[str, Any] = {}
+                if "image" in entries:
+                    from .online_loader import decode_image
+                    rec["image"] = decode_image(entries["image"])
+                if "caption" in entries:
+                    rec["text"] = entries["caption"].decode("utf-8")
+                return rec
+
+        return _Src()

@@ -1,0 +1,188 @@
+"""Sharded packed-record corpus source (VERDICT r2 next #7).
+
+The 20M-sample corpus shape from the reference's GCS ArrayRecord table
+(reference dataset_map.py:19-105, images.py:219-270) as an executable
+analogue: many shards -> one global index, lazy LRU-bounded shard
+readers, a mockable remote filesystem, and per-process-disjoint sharded
+reads through grain.
+"""
+import fnmatch
+import io
+
+import numpy as np
+import pytest
+
+from flaxdiff_tpu.data.packed_records import PackedRecordWriter
+from flaxdiff_tpu.data.sharded_source import (
+    PythonPackedReader,
+    ShardedPackedRecordSource,
+)
+
+
+def _write_shards(root, counts, with_images=False):
+    """Shard j holds records whose payload encodes (j, i) for identity
+    checks. with_images writes a real png so the decode path runs."""
+    paths = []
+    for j, n in enumerate(counts):
+        p = str(root / f"corpus-{j:05d}.pack")
+        with PackedRecordWriter(p) as w:
+            for i in range(n):
+                rec = {"caption": f"shard{j}-rec{i}".encode()}
+                if with_images:
+                    import cv2
+                    img = np.full((8, 8, 3), (j * 40 + i) % 255, np.uint8)
+                    ok, enc = cv2.imencode(".png", img)
+                    assert ok
+                    rec["image"] = enc.tobytes()
+                w.write(rec)
+        paths.append(p)
+    return paths
+
+
+class MemoryFS:
+    """In-memory stand-in for a remote object store (open + glob only —
+    the exact surface ShardedPackedRecordSource requires)."""
+
+    def __init__(self, files):
+        self.files = dict(files)
+        self.opens = 0
+
+    def open(self, path, mode="rb"):
+        self.opens += 1
+        return io.BytesIO(self.files[path])
+
+    def glob(self, pattern):
+        return sorted(p for p in self.files if fnmatch.fnmatch(p, pattern))
+
+
+def test_global_index_and_locate(tmp_path):
+    _write_shards(tmp_path, [3, 5, 2])
+    src = ShardedPackedRecordSource(pattern=str(tmp_path / "*.pack"),
+                                    decode=False)
+    s = src.get_source()
+    assert len(s) == 10
+    assert src.locate(0) == (str(tmp_path / "corpus-00000.pack"), 0)
+    assert src.locate(3) == (str(tmp_path / "corpus-00001.pack"), 0)
+    assert src.locate(7) == (str(tmp_path / "corpus-00001.pack"), 4)
+    assert src.locate(8) == (str(tmp_path / "corpus-00002.pack"), 0)
+    with pytest.raises(IndexError):
+        src.locate(10)
+    # identity of every record across shard boundaries
+    got = [s[i]["caption"].decode() for i in range(10)]
+    assert got == [f"shard{j}-rec{i}"
+                   for j, n in enumerate([3, 5, 2]) for i in range(n)]
+
+
+def test_lru_bounds_open_readers(tmp_path):
+    _write_shards(tmp_path, [2, 2, 2, 2])
+    src = ShardedPackedRecordSource(pattern=str(tmp_path / "*.pack"),
+                                    decode=False, max_open=2)
+    s = src.get_source()
+    for i in range(8):
+        s[i]
+    assert len(src._readers) <= 2
+
+
+def test_remote_filesystem_python_reader(tmp_path):
+    paths = _write_shards(tmp_path, [4, 3])
+    fs = MemoryFS({f"bucket/{i}.pack": open(p, "rb").read()
+                   for i, p in enumerate(paths)})
+    src = ShardedPackedRecordSource(pattern="bucket/*.pack",
+                                    filesystem=fs, decode=False)
+    s = src.get_source()
+    assert len(s) == 7
+    assert s[0]["caption"] == b"shard0-rec0"
+    assert s[6]["caption"] == b"shard1-rec2"
+    # the remote reader verifies v2 CRCs
+    r = PythonPackedReader(fs, "bucket/0.pack")
+    assert r.version == 2
+    assert all(r.verify(i) for i in range(len(r)))
+    r.close()
+
+
+def test_remote_reader_rejects_garbage():
+    fs = MemoryFS({"x.pack": b"NOPE" + b"\0" * 32})
+    with pytest.raises(IOError, match="not a packed record"):
+        PythonPackedReader(fs, "x.pack")
+
+
+def test_python_reader_matches_native(tmp_path):
+    """Same bytes out of both read paths for every record."""
+    from flaxdiff_tpu.data.packed_records import PackedRecordReader
+    [p] = _write_shards(tmp_path, [6])
+    native = PackedRecordReader(p)
+    fs = MemoryFS({p: open(p, "rb").read()})
+    python = PythonPackedReader(fs, p)
+    assert len(native) == len(python) == 6
+    for i in range(6):
+        assert native.record_bytes(i) == python.record_bytes(i)
+    python.close()
+
+
+def test_per_process_sharded_reads(tmp_path):
+    """grain ShardOptions slices over the GLOBAL record space: two
+    simulated processes see disjoint records covering the corpus — the
+    reference's ShardByJaxProcess behavior over its shard table
+    (reference dataloaders.py:297-305)."""
+    import grain.python as pygrain
+    _write_shards(tmp_path, [4, 4, 4])
+    src = ShardedPackedRecordSource(pattern=str(tmp_path / "*.pack"),
+                                    decode=False)
+    seen = []
+    for pi in range(2):
+        sampler = pygrain.IndexSampler(
+            num_records=12, shuffle=True, seed=3, num_epochs=1,
+            shard_options=pygrain.ShardOptions(shard_index=pi,
+                                               shard_count=2,
+                                               drop_remainder=True))
+        loader = pygrain.DataLoader(data_source=src.get_source(),
+                                    sampler=sampler, worker_count=0)
+        seen.append({rec["caption"].decode() for rec in loader})
+    assert seen[0] and seen[1]
+    assert not (seen[0] & seen[1])
+    assert len(seen[0] | seen[1]) == 12
+
+
+def test_packed_shards_dataset_entry_trains_shape(tmp_path):
+    """The registry entry flows through get_dataset_grain to trainer-
+    contract batches (decode path: real pngs)."""
+    from flaxdiff_tpu.data.dataloaders import get_dataset_grain
+    from flaxdiff_tpu.data.dataset_map import get_dataset
+    _write_shards(tmp_path, [6, 6], with_images=True)
+    ds = get_dataset("packed_shards", pattern=str(tmp_path / "*.pack"),
+                     image_size=16)
+    data = get_dataset_grain(ds, batch_size=4, image_size=16,
+                             worker_count=0)
+    batch = next(data["train"](seed=0))
+    assert batch["sample"].shape == (4, 16, 16, 3)
+    assert len(batch["text"]) == 4
+
+
+def test_empty_glob_raises():
+    with pytest.raises(FileNotFoundError):
+        ShardedPackedRecordSource(pattern="nomatch/*.pack")
+
+
+def test_path_override_reglobs(tmp_path):
+    a, b = tmp_path / "a", tmp_path / "b"
+    a.mkdir(), b.mkdir()
+    _write_shards(a, [2])
+    _write_shards(b, [3, 3])
+    src = ShardedPackedRecordSource(pattern=str(a / "*.pack"), decode=False)
+    assert len(src.get_source()) == 2
+    assert len(src.get_source(path_override=str(b / "*.pack"))) == 6
+
+def test_source_pickles_for_grain_workers(tmp_path):
+    """grain worker processes pickle the source; the lock and warm reader
+    cache must not travel (the at-scale config runs 32 workers)."""
+    import pickle
+    _write_shards(tmp_path, [3, 3])
+    src = ShardedPackedRecordSource(pattern=str(tmp_path / "*.pack"),
+                                    decode=False)
+    s = src.get_source()
+    s[4]                      # warm one reader
+    clone = pickle.loads(pickle.dumps(src))
+    assert len(clone._readers) == 0
+    assert clone.get_source()[4]["caption"] == b"shard1-rec1"
+    # original still works after the round trip
+    assert s[0]["caption"] == b"shard0-rec0"
