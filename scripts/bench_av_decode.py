@@ -1,0 +1,146 @@
+"""AV decode throughput + memory-leak harness.
+
+First-party counterpart of the reference's decoder benchmark
+(reference data/benchmark_decord.py:140-274: per-reader throughput and
+RSS-growth-over-iterations for its decord/opencv/pyav clip readers).
+Here the reader under test is the cv2/ffmpeg path behind
+`read_av_random_clip` (flaxdiff_tpu/data/sources/av.py) plus the
+frames-only `_read_frames_at_times` fast path.
+
+Measures, over N iterations per mode:
+  clips/sec, video-frames/sec, p50/p95 clip latency, and RSS at
+  start/middle/end (leak detection: steady-state RSS growth, not the
+  first-touch allocation ramp).
+
+Prints ONE JSON line; --out also writes it to a file the driver can
+collect. Synthesizes its own test video (cv2 mp4 + sine sidecar wav)
+unless --video is given, so the harness runs hermetically anywhere.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def rss_mib() -> float:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    return float("nan")
+
+
+def synthesize_video(path: str, size: int = 128, dur: float = 6.0,
+                     fps: float = 25.0, sr: int = 16000):
+    import cv2
+    import numpy as np
+    from scipy.io import wavfile
+    w = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"), fps,
+                        (size, size))
+    if not w.isOpened():
+        raise RuntimeError("cv2 VideoWriter failed to open")
+    r = np.random.default_rng(0)
+    for i in range(int(dur * fps)):
+        frame = np.full((size, size, 3), (i * 5) % 255, np.uint8)
+        frame[: size // 3] = r.integers(0, 255, (size // 3, size, 3),
+                                        dtype=np.uint8)
+        w.write(frame)
+    w.release()
+    t = np.arange(int(dur * sr), dtype=np.float32) / sr
+    audio = (0.4 * np.sin(2 * np.pi * 440 * t) * 32767).astype(np.int16)
+    wavfile.write(path.rsplit(".", 1)[0] + ".wav", sr, audio)
+    return path
+
+
+def bench_mode(mode: str, video: str, iters: int, num_frames: int):
+    import numpy as np
+
+    from flaxdiff_tpu.data.sources.av import (
+        _read_frames_at_times,
+        read_av_random_clip,
+        video_fps,
+    )
+
+    rng = np.random.default_rng(0)
+    fps = video_fps(video)
+
+    def one(i):
+        if mode == "av_clip":
+            audio, _, frames = read_av_random_clip(
+                video, num_frames=num_frames, rng=rng)
+            return frames.shape[0]
+        times = (np.arange(num_frames) + rng.integers(0, 8)) / max(fps, 1)
+        frames = _read_frames_at_times(video, times, fps)
+        return len(frames)
+
+    one(0)  # warm caches / lazy imports before timing
+    rss0 = rss_mib()
+    lat = []
+    frames_total = 0
+    rss_mid = None
+    t_start = time.perf_counter()
+    for i in range(iters):
+        t0 = time.perf_counter()
+        frames_total += one(i)
+        lat.append(time.perf_counter() - t0)
+        if i == iters // 2:
+            rss_mid = rss_mib()
+    wall = time.perf_counter() - t_start
+    rss1 = rss_mib()
+    lat.sort()
+    return {
+        "mode": mode,
+        "iters": iters,
+        "clips_per_sec": round(iters / wall, 2),
+        "frames_per_sec": round(frames_total / wall, 1),
+        "p50_ms": round(lat[len(lat) // 2] * 1e3, 1),
+        "p95_ms": round(lat[int(len(lat) * 0.95)] * 1e3, 1),
+        "rss_start_mib": round(rss0, 1),
+        "rss_mid_mib": round(rss_mid, 1) if rss_mid else None,
+        "rss_end_mib": round(rss1, 1),
+        # steady-state growth (mid -> end) is the leak signal; start ->
+        # mid includes first-touch allocations (reference
+        # benchmark_decord.py measures the same distinction)
+        "rss_growth_steady_mib": round(rss1 - (rss_mid or rss0), 1),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--video", default=None,
+                    help="existing video (default: synthesize one)")
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--num_frames", type=int, default=16)
+    ap.add_argument("--modes", default="av_clip,frames_only")
+    ap.add_argument("--out", default=None, help="also write JSON here")
+    args = ap.parse_args(argv)
+
+    video = args.video
+    tmp = None
+    if video is None:
+        import tempfile
+        tmp = tempfile.mkdtemp()
+        video = synthesize_video(os.path.join(tmp, "bench.mp4"))
+
+    results = [bench_mode(m.strip(), video, args.iters, args.num_frames)
+               for m in args.modes.split(",") if m.strip()]
+    line = {"metric": "av_decode", "video": os.path.basename(video),
+            "results": results}
+    print(json.dumps(line), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(line, f)
+
+    if tmp:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+    return line
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    main()

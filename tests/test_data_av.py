@@ -223,3 +223,26 @@ def test_av_batch_trains_unet3d_step(av_file):
     loss1 = float(trainer.train_step(trainer.put_batch(batch)))
     loss2 = float(trainer.train_step(trainer.put_batch(batch)))
     assert np.isfinite(loss1) and np.isfinite(loss2)
+
+
+def test_av_decode_bench_harness(tmp_path, make_av_file):
+    """The throughput/leak harness (scripts/bench_av_decode.py, reference
+    benchmark_decord.py:140-274 analogue) runs and emits sane JSON."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "bench_av_decode", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "bench_av_decode.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    video = make_av_file(tmp_path / "clip.mp4", size=32, dur=2)
+    out = mod.main(["--video", video, "--iters", "4",
+                    "--num_frames", "4",
+                    "--out", str(tmp_path / "av.json")])
+    assert {r["mode"] for r in out["results"]} == {"av_clip", "frames_only"}
+    for r in out["results"]:
+        assert r["clips_per_sec"] > 0
+        assert r["frames_per_sec"] > 0
+        assert np.isfinite(r["rss_end_mib"])
+    assert (tmp_path / "av.json").exists()
