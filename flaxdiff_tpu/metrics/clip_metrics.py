@@ -17,6 +17,14 @@ from .common import EvaluationMetric
 _CLIP_CACHE: dict = {}
 
 
+def register_clip_model(modelname: str, model, processor):
+    """Register a (model, processor) pair under `modelname`, bypassing
+    the pretrained download — offline tests inject a tiny random
+    config-built FlaxCLIPModel here so the metric path (real model
+    forward + similarity math) runs end to end without network."""
+    _CLIP_CACHE[modelname] = (model, processor)
+
+
 def cosine_similarity(a: jax.Array, b: jax.Array, eps: float = 1e-8
                       ) -> jax.Array:
     """Row-wise cosine similarity between [N, D] feature batches."""
