@@ -9,6 +9,7 @@ from .autoencoder import (
     StableDiffusionVAE,
 )
 from .dit import DiTBlock, SimpleDiT
+from .sd_vae import SDVAE, SDDecoder, SDEncoder, convert_sd_vae_torch_state_dict
 from .mmdit import (
     HierarchicalMMDiT,
     MMAdaLNZero,

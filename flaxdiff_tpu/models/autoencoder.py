@@ -207,7 +207,52 @@ def kl_divergence(moments: jax.Array) -> jax.Array:
                          axis=tuple(range(1, mean.ndim)))
 
 
-class KLAutoEncoder(AutoEncoder):
+class JittedVAE(AutoEncoder):
+    """Shared plumbing for params-bound codecs (KL VAE, SD VAE): jitted
+    encode/decode with the scaling factor as a jit ARGUMENT, not a
+    captured constant — users set it after measuring latent std (SD
+    convention) and a baked-in trace would silently keep using the old
+    value. Subclasses call `_bind(moments_fn, decode_fn)` after setting
+    `params`/`scaling_factor` and provide only the architecture-specific
+    moment/decode bodies."""
+
+    def _bind(self, moments_fn, decode_fn) -> None:
+        # moments_fn(params, x) -> concatenated (mean, logvar);
+        # decode_fn(params, z) -> image, z already unscaled
+        def _enc(params, x, key, scale):
+            return gaussian_sample(moments_fn(params, x), key) * scale
+
+        def _dec(params, z, scale):
+            return decode_fn(params, z / scale)
+
+        self._moments_fn = jax.jit(moments_fn)
+        self._enc = jax.jit(_enc)
+        self._enc_mean = jax.jit(lambda p, x, s: _enc(p, x, None, s))
+        self._dec = jax.jit(_dec)
+
+    def moments(self, x: jax.Array) -> jax.Array:
+        """Raw (mean, logvar) — used by VAE training losses."""
+        return self._moments_fn(self.params, x)
+
+    def __encode__(self, x, key=None, **kwargs):
+        scale = jnp.float32(self.scaling_factor)
+        if key is None:
+            return self._enc_mean(self.params, x, scale)
+        return self._enc(self.params, x, key, scale)
+
+    def __decode__(self, z, key=None, **kwargs):
+        return self._dec(self.params, z, jnp.float32(self.scaling_factor))
+
+    @property
+    def downscale_factor(self) -> int:
+        return self._downscale
+
+    @property
+    def latent_channels(self) -> int:
+        return self._latent_channels
+
+
+class KLAutoEncoder(JittedVAE):
     """First-party trainable KL VAE bound to a parameter tree.
 
     Construct with `KLAutoEncoder.create(key, ...)` for fresh params or pass
@@ -233,21 +278,11 @@ class KLAutoEncoder(AutoEncoder):
         self.decoder = KLDecoder(out_channels, self._block_channels,
                                  layers_per_block, norm_groups, dtype)
         self._downscale = 2 ** (len(self._block_channels) - 1)
-
-        # scaling_factor is a jit ARGUMENT, not a captured constant: users
-        # set it after measuring latent std (SD convention) and a baked-in
-        # trace would silently keep using the old value.
-        def _enc(params, x, key, scale):
-            moments = self.encoder.apply({"params": params["encoder"]}, x)
-            return gaussian_sample(moments, key) * scale
-
-        def _dec(params, z, scale):
-            return self.decoder.apply({"params": params["decoder"]},
-                                      z / scale)
-
-        self._enc = jax.jit(_enc)
-        self._enc_mean = jax.jit(lambda p, x, s: _enc(p, x, None, s))
-        self._dec = jax.jit(_dec)
+        self._bind(
+            lambda params, x: self.encoder.apply(
+                {"params": params["encoder"]}, x),
+            lambda params, z: self.decoder.apply(
+                {"params": params["decoder"]}, z))
 
     @classmethod
     def create(cls, key: jax.Array, *, input_channels: int = 3,
@@ -269,27 +304,6 @@ class KLAutoEncoder(AutoEncoder):
                   "decoder": dec.init(dk, z)["params"]}
         kwargs.setdefault("out_channels", input_channels)
         return cls(params, **kwargs)
-
-    def moments(self, x: jax.Array) -> jax.Array:
-        """Raw (mean, logvar) — used by the VAE training loss."""
-        return self.encoder.apply({"params": self.params["encoder"]}, x)
-
-    def __encode__(self, x, key=None, **kwargs):
-        scale = jnp.float32(self.scaling_factor)
-        if key is None:
-            return self._enc_mean(self.params, x, scale)
-        return self._enc(self.params, x, key, scale)
-
-    def __decode__(self, z, key=None, **kwargs):
-        return self._dec(self.params, z, jnp.float32(self.scaling_factor))
-
-    @property
-    def downscale_factor(self) -> int:
-        return self._downscale
-
-    @property
-    def latent_channels(self) -> int:
-        return self._latent_channels
 
     @property
     def name(self) -> str:
@@ -395,8 +409,16 @@ class StableDiffusionVAE(AutoEncoder):
                 "dtype": str(self.dtype)}
 
 
+def _sd_vae(**kwargs):
+    # local import: sd_vae imports this module for the ABC
+    from .sd_vae import SDVAE
+    return SDVAE(**kwargs) if "params" in kwargs else SDVAE.create(
+        jax.random.PRNGKey(kwargs.pop("seed", 0)), **kwargs)
+
+
 AUTOENCODER_REGISTRY = {
     "identity": IdentityAutoEncoder,
     "kl_vae": KLAutoEncoder,
+    "sd_vae": _sd_vae,
     "stable_diffusion": StableDiffusionVAE,
 }

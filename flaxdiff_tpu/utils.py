@@ -113,6 +113,49 @@ def tree_bytes(tree: PyTree) -> int:
                if hasattr(x, "dtype"))
 
 
+def fill_params_by_path(template: PyTree, flat: dict, prefix: str = "",
+                        label: str = "weight load") -> PyTree:
+    """Fill `template`'s leaves from a '/'-path-keyed dict of arrays
+    (optionally under `prefix`), matched by PATH with shape checking:
+    every template leaf must be present and every prefixed key consumed,
+    or a ValueError lists what's missing/mismatched/unused. Template
+    leaves only need .shape/.dtype, so `jax.eval_shape` output works —
+    no real init required. Shared by the InceptionV3 FID loader and the
+    SD-VAE torch-weight loader."""
+    sub = {k[len(prefix):]: v for k, v in flat.items()
+           if k.startswith(prefix)}
+    leaves_kp, treedef = jax.tree_util.tree_flatten_with_path(template)
+    missing, mismatched, leaves = [], [], []
+    for path, leaf in leaves_kp:
+        key = "/".join(
+            getattr(p, "key", getattr(p, "name", str(p))) for p in path)
+        if key not in sub:
+            missing.append(key)
+            leaves.append(leaf)
+            continue
+        arr = sub.pop(key)
+        if tuple(arr.shape) != tuple(leaf.shape):
+            mismatched.append(f"{key}: file {arr.shape} vs model "
+                              f"{tuple(leaf.shape)}")
+            leaves.append(leaf)
+            continue
+        leaves.append(jnp.asarray(arr, dtype=leaf.dtype))
+    errors = []
+    if missing:
+        errors.append(f"missing: {sorted(missing)[:5]}"
+                      f"{' ...' if len(missing) > 5 else ''} "
+                      f"({len(missing)} total)")
+    if mismatched:
+        errors.append(f"shape mismatches: {mismatched[:5]}")
+    if sub:
+        errors.append(f"unused keys: {sorted(sub)[:5]} ({len(sub)} total)")
+    if errors:
+        raise ValueError(
+            f"{label} failed{f' under {prefix!r}' if prefix else ''} — "
+            + "; ".join(errors))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def form_global_array(path, array: np.ndarray, global_mesh: jax.sharding.Mesh,
                       axis_name: str = "data") -> jax.Array:
     """Assemble a host-local numpy batch shard into a global jax.Array.

@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Convert Stable Diffusion VAE (diffusers AutoencoderKL) torch weights
+to the flaxdiff_tpu .npz format.
+
+Usage:
+    python scripts/convert_sd_vae_weights.py diffusion_pytorch_model.bin \
+        sd_vae.npz
+    # or a .safetensors file of the same state dict
+
+The input is the torch state dict of any diffusers `AutoencoderKL`
+(e.g. from CompVis/stable-diffusion-v1-4's `vae/` folder — the weights
+the reference downloads through diffusers in
+flaxdiff/models/autoencoder/diffusers.py:30-44). Both the modern
+(`to_q`/`to_out.0`) and legacy (`query`/`proj_attn`, 1x1-conv
+projections) attention namings are handled. The name/layout mapping
+lives in flaxdiff_tpu.models.sd_vae.convert_sd_vae_torch_state_dict so
+it is unit tested without torch; this script only deserializes.
+
+After converting, load it first-party (no diffusers needed):
+    from flaxdiff_tpu.models import SDVAE
+    vae = SDVAE.from_npz("sd_vae.npz")
+"""
+import sys
+
+import numpy as np
+
+from flaxdiff_tpu.models.sd_vae import SDVAE, convert_sd_vae_torch_state_dict
+
+
+def main():
+    if len(sys.argv) != 3:
+        raise SystemExit(__doc__)
+    src, dst = sys.argv[1], sys.argv[2]
+
+    if src.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+        state = load_file(src)
+    else:
+        import torch
+        state = torch.load(src, map_location="cpu", weights_only=True)
+        if hasattr(state, "state_dict"):
+            state = state.state_dict()
+        state = {k: v.float().numpy() for k, v in state.items()}
+
+    converted = convert_sd_vae_torch_state_dict(state)
+    np.savez(dst, **converted)
+    # prove the converted file assembles into the model before declaring ok
+    vae = SDVAE.from_npz(dst)
+    print(f"wrote {dst}: {len(converted)} arrays, "
+          f"config={vae.serialize()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,297 @@
+"""First-party SD-VAE: architecture, converter, and cross-framework
+parity against a torch twin built with diffusers AutoencoderKL
+state-dict naming (upgrades VERDICT r2 component #30 from
+diffusers-gated to parity-tested; real weights still need network, but
+any layout/padding/eps/attention divergence shows up here)."""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flaxdiff_tpu.models.sd_vae import (
+    SDVAE,
+    assemble_params,
+    convert_sd_vae_torch_state_dict,
+)
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+from torch import nn  # noqa: E402
+
+GROUPS = 4
+CHANS = (8, 16, 16, 16)
+LATENT = 4
+LAYERS = 1
+
+
+# ---------------------------------------------------------------------------
+# Torch twin with diffusers AutoencoderKL naming
+# ---------------------------------------------------------------------------
+
+class TResnet(nn.Module):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.norm1 = nn.GroupNorm(GROUPS, cin, eps=1e-6)
+        self.conv1 = nn.Conv2d(cin, cout, 3, padding=1)
+        self.norm2 = nn.GroupNorm(GROUPS, cout, eps=1e-6)
+        self.conv2 = nn.Conv2d(cout, cout, 3, padding=1)
+        if cin != cout:
+            self.conv_shortcut = nn.Conv2d(cin, cout, 1)
+
+    def forward(self, x):
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = self.conv2(F.silu(self.norm2(h)))
+        if hasattr(self, "conv_shortcut"):
+            x = self.conv_shortcut(x)
+        return x + h
+
+
+class TAttn(nn.Module):
+    def __init__(self, c):
+        super().__init__()
+        self.group_norm = nn.GroupNorm(GROUPS, c, eps=1e-6)
+        self.to_q = nn.Linear(c, c)
+        self.to_k = nn.Linear(c, c)
+        self.to_v = nn.Linear(c, c)
+        self.to_out = nn.Sequential(nn.Linear(c, c))
+
+    def forward(self, x):
+        b, c, h, w = x.shape
+        y = self.group_norm(x).reshape(b, c, h * w).permute(0, 2, 1)
+        q, k, v = self.to_q(y), self.to_k(y), self.to_v(y)
+        attn = torch.softmax(q @ k.transpose(1, 2) / math.sqrt(c), dim=-1)
+        out = self.to_out(attn @ v).permute(0, 2, 1).reshape(b, c, h, w)
+        return x + out
+
+
+class TDownsample(nn.Module):
+    def __init__(self, c):
+        super().__init__()
+        self.conv = nn.Conv2d(c, c, 3, stride=2, padding=0)
+
+    def forward(self, x):
+        return self.conv(F.pad(x, (0, 1, 0, 1)))
+
+
+class TUpsample(nn.Module):
+    def __init__(self, c):
+        super().__init__()
+        self.conv = nn.Conv2d(c, c, 3, padding=1)
+
+    def forward(self, x):
+        return self.conv(F.interpolate(x, scale_factor=2, mode="nearest"))
+
+
+class TDownBlock(nn.Module):
+    def __init__(self, cin, cout, down):
+        super().__init__()
+        self.resnets = nn.ModuleList(
+            [TResnet(cin if j == 0 else cout, cout) for j in range(LAYERS)])
+        if down:
+            self.downsamplers = nn.ModuleList([TDownsample(cout)])
+
+    def forward(self, x):
+        for r in self.resnets:
+            x = r(x)
+        if hasattr(self, "downsamplers"):
+            x = self.downsamplers[0](x)
+        return x
+
+
+class TUpBlock(nn.Module):
+    def __init__(self, cin, cout, up):
+        super().__init__()
+        self.resnets = nn.ModuleList(
+            [TResnet(cin if j == 0 else cout, cout)
+             for j in range(LAYERS + 1)])
+        if up:
+            self.upsamplers = nn.ModuleList([TUpsample(cout)])
+
+    def forward(self, x):
+        for r in self.resnets:
+            x = r(x)
+        if hasattr(self, "upsamplers"):
+            x = self.upsamplers[0](x)
+        return x
+
+
+class TMidBlock(nn.Module):
+    def __init__(self, c):
+        super().__init__()
+        self.resnets = nn.ModuleList([TResnet(c, c), TResnet(c, c)])
+        self.attentions = nn.ModuleList([TAttn(c)])
+
+    def forward(self, x):
+        return self.resnets[1](self.attentions[0](self.resnets[0](x)))
+
+
+class TEncoder(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv_in = nn.Conv2d(3, CHANS[0], 3, padding=1)
+        self.down_blocks = nn.ModuleList([
+            TDownBlock(CHANS[max(i - 1, 0)], c, i < len(CHANS) - 1)
+            for i, c in enumerate(CHANS)])
+        self.mid_block = TMidBlock(CHANS[-1])
+        self.conv_norm_out = nn.GroupNorm(GROUPS, CHANS[-1], eps=1e-6)
+        self.conv_out = nn.Conv2d(CHANS[-1], 2 * LATENT, 3, padding=1)
+
+    def forward(self, x):
+        x = self.conv_in(x)
+        for b in self.down_blocks:
+            x = b(x)
+        x = self.mid_block(x)
+        return self.conv_out(F.silu(self.conv_norm_out(x)))
+
+
+class TDecoder(nn.Module):
+    def __init__(self):
+        super().__init__()
+        rev = CHANS[::-1]
+        self.conv_in = nn.Conv2d(LATENT, rev[0], 3, padding=1)
+        self.mid_block = TMidBlock(rev[0])
+        self.up_blocks = nn.ModuleList([
+            TUpBlock(rev[max(i - 1, 0)], c, i < len(rev) - 1)
+            for i, c in enumerate(rev)])
+        self.conv_norm_out = nn.GroupNorm(GROUPS, rev[-1], eps=1e-6)
+        self.conv_out = nn.Conv2d(rev[-1], 3, 3, padding=1)
+
+    def forward(self, z):
+        z = self.mid_block(self.conv_in(z))
+        for b in self.up_blocks:
+            z = b(z)
+        return self.conv_out(F.silu(self.conv_norm_out(z)))
+
+
+class TVAE(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.encoder = TEncoder()
+        self.decoder = TDecoder()
+        self.quant_conv = nn.Conv2d(2 * LATENT, 2 * LATENT, 1)
+        self.post_quant_conv = nn.Conv2d(LATENT, LATENT, 1)
+
+    def moments(self, x):
+        return self.quant_conv(self.encoder(x))
+
+    def decode(self, z):
+        return self.decoder(self.post_quant_conv(z))
+
+
+@pytest.fixture(scope="module")
+def twins():
+    torch.manual_seed(7)
+    tvae = TVAE().eval()
+    state = {k: v.numpy() for k, v in tvae.state_dict().items()}
+    vae = SDVAE.from_torch_state_dict(state, norm_groups=GROUPS,
+                                      scaling_factor=1.0)
+    return tvae, vae
+
+
+def test_config_inferred_from_checkpoint(twins):
+    _, vae = twins
+    cfg = vae.serialize()
+    assert cfg["block_out_channels"] == list(CHANS)
+    assert cfg["latent_channels"] == LATENT
+    assert cfg["layers_per_block"] == LAYERS
+    assert vae.downscale_factor == 8
+
+
+def test_encode_moments_parity(twins):
+    tvae, vae = twins
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 32, 32, 3), dtype=np.float32)
+    with torch.no_grad():
+        want = tvae.moments(torch.from_numpy(x.transpose(0, 3, 1, 2)))
+    got = np.asarray(vae.moments(jnp.asarray(x)))
+    np.testing.assert_allclose(got, want.numpy().transpose(0, 2, 3, 1),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_decode_parity(twins):
+    tvae, vae = twins
+    rng = np.random.default_rng(1)
+    z = rng.standard_normal((2, 4, 4, LATENT), dtype=np.float32)
+    with torch.no_grad():
+        want = tvae.decode(torch.from_numpy(z.transpose(0, 3, 1, 2)))
+    got = np.asarray(vae.decode(jnp.asarray(z)))
+    np.testing.assert_allclose(got, want.numpy().transpose(0, 2, 3, 1),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_encode_mean_matches_moments_mean(twins):
+    _, vae = twins
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((1, 32, 32, 3), dtype=np.float32))
+    mean = np.asarray(vae.moments(x))[..., :LATENT]
+    np.testing.assert_allclose(np.asarray(vae.encode(x)), mean,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_legacy_attention_naming(twins):
+    """CompVis-era checkpoints name the attention projections
+    query/key/value/proj_attn and store them as 1x1 convs — the
+    converter must accept both namings identically."""
+    tvae, vae = twins
+    state = {}
+    for k, v in tvae.state_dict().items():
+        v = v.numpy()
+        for new, old in (("to_q", "query"), ("to_k", "key"),
+                         ("to_v", "value"), ("to_out.0", "proj_attn")):
+            if f".{new}." in k:
+                k = k.replace(f".{new}.", f".{old}.")
+                if v.ndim == 2:  # Linear -> 1x1 conv layout
+                    v = v[:, :, None, None]
+                break
+        state[k] = v
+    legacy = SDVAE.from_torch_state_dict(state, norm_groups=GROUPS,
+                                         scaling_factor=1.0)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((1, 32, 32, 3), dtype=np.float32))
+    np.testing.assert_allclose(np.asarray(legacy.moments(x)),
+                               np.asarray(vae.moments(x)),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_converter_rejects_unknown_names():
+    with pytest.raises(ValueError, match="unmapped"):
+        convert_sd_vae_torch_state_dict(
+            {"encoder.conv_in.running_gizmo": np.zeros((3,))})
+
+
+def test_assemble_rejects_missing_and_unused():
+    template = {"a": {"kernel": jnp.zeros((2, 2))}}
+    with pytest.raises(ValueError, match="missing"):
+        assemble_params(template, {}, "")
+    with pytest.raises(ValueError, match="unused"):
+        assemble_params(template, {"a/kernel": np.zeros((2, 2)),
+                                   "b/kernel": np.zeros((1,))}, "")
+    with pytest.raises(ValueError, match="mismatch"):
+        assemble_params(template, {"a/kernel": np.zeros((3, 3))}, "")
+
+
+def test_video_flattening_and_registry():
+    from flaxdiff_tpu.models.autoencoder import AUTOENCODER_REGISTRY
+    vae = AUTOENCODER_REGISTRY["sd_vae"](
+        block_out_channels=(8, 8), norm_groups=4, layers_per_block=1,
+        image_size=16)
+    vid = jnp.zeros((2, 3, 16, 16, 3))
+    z = vae.encode(vid)
+    assert z.shape == (2, 3, 8, 8, 4)
+    assert vae.decode(z).shape == vid.shape
+    assert vae.name == "sd_vae"
+
+
+def test_scaling_factor_applied():
+    vae = SDVAE.create(jax.random.PRNGKey(0), block_out_channels=(8, 8),
+                       norm_groups=4, layers_per_block=1, image_size=16,
+                       scaling_factor=2.0)
+    x = jnp.ones((1, 16, 16, 3))
+    z = vae.encode(x)
+    vae1 = SDVAE(vae.params, block_out_channels=(8, 8), norm_groups=4,
+                 layers_per_block=1, scaling_factor=1.0)
+    np.testing.assert_allclose(np.asarray(z),
+                               2.0 * np.asarray(vae1.encode(x)),
+                               rtol=1e-6)
