@@ -37,15 +37,27 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 LANES = 128
 
+# Test hook: interpret mode normally shrinks the lane-replicated scratch
+# to width 1, which skips the lane resize paths real TPU hits (the d<128
+# native-head-dim bug the r3 bench's attnpad stage caught lived there).
+# Tests set this to LANES to run interpret with the hardware layout.
+_FORCE_LANES: Optional[int] = None
+
 
 def _bcast(x: jax.Array, width: int) -> jax.Array:
-    """Widen a lane-replicated [rows, w] value to [rows, width]."""
+    """Resize a lane-replicated [rows, w] value to [rows, width] — every
+    lane holds the same value, so slicing narrower (native head_dim < 128
+    against the 128-lane scratch) is as exact as repeating wider."""
     w = x.shape[1]
     if w == width:
         return x
     if w == 1:
         return jnp.broadcast_to(x, (x.shape[0], width))
-    return pltpu.repeat(x, width // w, axis=1)
+    if width < w:
+        return x[:, :width]
+    reps = -(-width // w)
+    out = pltpu.repeat(x, reps, axis=1)
+    return out if out.shape[1] == width else out[:, :width]
 
 
 # ---------------------------------------------------------------------------
@@ -231,7 +243,7 @@ def _fwd_impl(q, k, v, scale, block_q, block_k, interpret,
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     bq, bk = _block_sizes(lq, kv_len, block_q, block_k, interpret)
-    lanes = 1 if interpret else LANES
+    lanes = _FORCE_LANES or (1 if interpret else LANES)
 
     qb = _pad_to(_to_bh(q), 1, bq)
     kb = _pad_to(_to_bh(k), 1, bk)
@@ -349,8 +361,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     block_k: int = 128, interpret: bool = False) -> jax.Array:
     """Flash attention over [B, L, H, D] tensors (full fwd+bwd in Pallas).
 
-    head_dim must be a multiple of 128 on real TPU (the dispatch layer in
-    ops/attention.py zero-pads it); sequence dims are padded internally.
+    head_dim must be a multiple of 8 on real TPU — multiples of 128 use
+    full lanes; narrower dims are handled natively (Mosaic masks the
+    sub-128 lanes) when the dispatch layer passes them through
+    (FLAXDIFF_FLASH_NATIVE_D=1) and zero-padded to 128 otherwise.
+    Sequence dims are padded internally.
     """
     out, _ = _fwd_impl(q, k, v, scale, block_q, block_k, interpret)
     b, lq, h, _ = q.shape

@@ -73,6 +73,32 @@ def test_flash_attention_long_sequence_grad():
         np.testing.assert_allclose(a, b_, rtol=5e-3, atol=5e-3, err_msg=name)
 
 
+def test_flash_attention_native_head_dim_hw_lanes(monkeypatch):
+    """Native sub-128 head_dim with the HARDWARE 128-lane scratch layout
+    (interpret mode normally shrinks lanes to 1, which is why the
+    (128, 64)x(128, 0) broadcast bug in _bcast only surfaced on a real
+    chip — the r3 bench attnpad stage caught it). Forward and all grads
+    vs XLA at d=64 with full-width lane-replicated scratch."""
+    from flaxdiff_tpu.ops import flash_attention as fa
+    monkeypatch.setattr(fa, "_FORCE_LANES", fa.LANES)
+    key = jax.random.PRNGKey(7)
+    b, l, h, d = 1, 256, 2, 64
+    q = jax.random.normal(key, (b, l, h, d))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (b, l, h, d))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (b, l, h, d))
+    g = jax.random.normal(jax.random.fold_in(key, 3), (b, l, h, d))
+
+    flash = lambda q_, k_, v_: flash_attention(q_, k_, v_, None, 128, 128,
+                                               True)
+    np.testing.assert_allclose(flash(q, k, v), _xla_attention(q, k, v),
+                               rtol=2e-3, atol=2e-3)
+    got = jax.grad(lambda *a: jnp.sum(flash(*a) * g), (0, 1, 2))(q, k, v)
+    want = jax.grad(lambda *a: jnp.sum(_xla_attention(*a) * g),
+                    (0, 1, 2))(q, k, v)
+    for name, a, b_ in zip(("dq", "dk", "dv"), got, want):
+        np.testing.assert_allclose(a, b_, rtol=2e-3, atol=2e-3, err_msg=name)
+
+
 @pytest.mark.parametrize("apply_silu", [True, False])
 def test_fused_groupnorm_silu_matches_xla(apply_silu):
     key = jax.random.PRNGKey(0)
