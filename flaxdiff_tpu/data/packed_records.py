@@ -208,6 +208,23 @@ class PackedRecordReader:
             pass
 
 
+def decode_standard_record(entries: Dict[str, bytes]) -> Dict[str, Any]:
+    """Decode a packed record's image/caption entries into loader form
+    ({"image": HxWx3, "text": str}). Accepts both key namings in the
+    wild: the canonical image/caption AND webdataset-style jpg/txt
+    (scripts/pack_dataset.py < r3 wrote the latter, which no DataSource
+    decoded — records silently came back empty)."""
+    rec: Dict[str, Any] = {}
+    img = entries.get("image", entries.get("jpg"))
+    if img is not None:
+        from .online_loader import decode_image
+        rec["image"] = decode_image(img)
+    caption = entries.get("caption", entries.get("txt"))
+    if caption is not None:
+        rec["text"] = caption.decode("utf-8")
+    return rec
+
+
 @dataclasses.dataclass
 class PackedRecordSource(DataSource):
     """DataSource over a packed record file; decodes the standard
@@ -223,14 +240,7 @@ class PackedRecordSource(DataSource):
                 return len(reader)
 
             def __getitem__(self, i):
-                entries = reader[int(i)]
-                rec: Dict[str, Any] = {}
-                if "image" in entries:
-                    from .online_loader import decode_image
-                    rec["image"] = decode_image(entries["image"])
-                if "caption" in entries:
-                    rec["text"] = entries["caption"].decode("utf-8")
-                return rec
+                return decode_standard_record(reader[int(i)])
 
         return _Src()
 

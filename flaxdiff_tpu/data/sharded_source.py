@@ -29,7 +29,7 @@ import struct
 import threading
 import zlib
 from collections import OrderedDict
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, List, Optional, Sequence
 
 from .sources.base import DataSource
 
@@ -209,17 +209,12 @@ class ShardedPackedRecordSource(DataSource):
 
             def __getitem__(self, i):
                 path, local = outer.locate(int(i))
-                from .packed_records import unpack_record
+                from .packed_records import (decode_standard_record,
+                                             unpack_record)
                 entries = unpack_record(
                     outer._reader(path).record_bytes(local))
                 if not outer.decode:
                     return entries
-                rec: Dict[str, Any] = {}
-                if "image" in entries:
-                    from .online_loader import decode_image
-                    rec["image"] = decode_image(entries["image"])
-                if "caption" in entries:
-                    rec["text"] = entries["caption"].decode("utf-8")
-                return rec
+                return decode_standard_record(entries)
 
         return _Src()

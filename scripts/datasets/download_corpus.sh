@@ -1,0 +1,49 @@
+#!/bin/bash
+# Download an image-text corpus with img2dataset and pack it into this
+# framework's sharded packed-record format (data/sharded_source.py).
+#
+# Operational analogue of the reference's corpus downloaders
+# (reference datasets/cc12m downloader.sh, custom datasets
+# downloader.sh) with one deliberate difference: instead of emitting
+# ArrayRecord straight to GCS, we download webdataset shards locally
+# (or to a mounted bucket — see mount_gcs.sh) and pack them with
+# scripts/pack_dataset.py, whose output the native C++ reader and the
+# grain ShardedPackedSource consume directly.
+#
+# Usage:
+#   scripts/datasets/download_corpus.sh URL_LIST OUTPUT_DIR [IMAGE_SIZE]
+#
+#   URL_LIST    tsv/parquet of (url, caption) pairs, e.g. cc12m.tsv
+#   OUTPUT_DIR  where webdataset shards + packed shards land
+#   IMAGE_SIZE  resize target (default 256)
+#
+# Requires: pip install img2dataset  (not bundled with the framework)
+set -euo pipefail
+
+URL_LIST=${1:?usage: download_corpus.sh URL_LIST OUTPUT_DIR [IMAGE_SIZE]}
+OUT=${2:?usage: download_corpus.sh URL_LIST OUTPUT_DIR [IMAGE_SIZE]}
+SIZE=${3:-256}
+
+case "$URL_LIST" in
+  *.tsv)  FORMAT=tsv; URL_COL=image_url; CAP_COL=caption ;;
+  *.parquet) FORMAT=parquet; URL_COL=url; CAP_COL=caption ;;
+  *) echo "unsupported url list format: $URL_LIST" >&2; exit 1 ;;
+esac
+
+mkdir -p "$OUT/webdataset" "$OUT/packed"
+
+img2dataset \
+  --url_list "$URL_LIST" --input_format "$FORMAT" \
+  --url_col "$URL_COL" --caption_col "$CAP_COL" \
+  --output_format webdataset --output_folder "$OUT/webdataset" \
+  --image_size "$SIZE" --min_image_size 100 --max_aspect_ratio 2.4 \
+  --processes_count "$(nproc)" --thread_count 64 \
+  --number_sample_per_shard 50000 \
+  --compute_hash None --max_shard_retry 3 --timeout 60
+
+# Pack the webdataset shards into packed-record shards; the resulting
+# directory is loadable as `--dataset packed_shards:<OUT>/packed`.
+python "$(dirname "$0")/../pack_dataset.py" \
+  --src "$OUT/webdataset" --out "$OUT/packed" --shards 16
+
+echo "packed corpus ready: $OUT/packed"

@@ -1,0 +1,40 @@
+#!/bin/bash
+# Mount a GCS bucket for training-time corpus reads via gcsfuse.
+#
+# Operational analogue of the reference's datasets/gcsfuse.sh, tuned
+# for this framework's access pattern: packed-record shards are read
+# with large sequential batch reads (native/packed_reader.cpp uses
+# madvise(SEQUENTIAL)), so the mount favors kernel readahead and a
+# file-level cache over small random-read tuning.
+#
+# Usage:
+#   scripts/datasets/mount_gcs.sh BUCKET=my-dataset-bucket MOUNT_PATH=/data \
+#       [CACHE_DIR=/tmp/gcsfuse-cache]
+set -euo pipefail
+
+for ARG in "$@"; do
+  IFS='=' read -r KEY VALUE <<<"$ARG"
+  export "$KEY"="$VALUE"
+done
+
+: "${BUCKET:?usage: mount_gcs.sh BUCKET=... MOUNT_PATH=...}"
+: "${MOUNT_PATH:?usage: mount_gcs.sh BUCKET=... MOUNT_PATH=...}"
+CACHE_DIR=${CACHE_DIR:-/tmp/gcsfuse-cache}
+
+mkdir -p "$MOUNT_PATH" "$CACHE_DIR"
+
+gcsfuse \
+  --implicit-dirs \
+  --type-cache-max-size-mb=-1 \
+  --stat-cache-max-size-mb=-1 \
+  --kernel-list-cache-ttl-secs=-1 \
+  --metadata-cache-ttl-secs=-1 \
+  --file-cache-max-size-mb=-1 \
+  --cache-dir="$CACHE_DIR" \
+  --file-cache-cache-file-for-range-read=true \
+  --file-cache-enable-parallel-downloads=true \
+  -o ro \
+  "$BUCKET" "$MOUNT_PATH"
+
+echo "mounted gs://$BUCKET at $MOUNT_PATH (read-only, file cache: $CACHE_DIR)"
+echo "use with: --dataset packed_shards:$MOUNT_PATH/<corpus>/packed"

@@ -60,6 +60,43 @@ def iter_folder(src: str, caption_from_name: bool):
             yield img[..., ::-1], caption  # BGR -> RGB
 
 
+def iter_webdataset_tar(src: str):
+    """Iterate (encoded_image_bytes, caption) from webdataset-layout
+    .tar shards (the img2dataset output format
+    scripts/datasets/download_corpus.sh uses): members grouped by
+    basename, image under .jpg/.png/..., caption in the sibling .txt
+    entry. Bytes are yielded UNDECODED — when no resize is requested,
+    main() writes them through verbatim (no decode/re-encode pass or
+    JPEG generation loss over a many-million-sample corpus)."""
+    import tarfile
+    tars = ([src] if src.endswith(".tar") else
+            sorted(os.path.join(src, f) for f in os.listdir(src)
+                   if f.endswith(".tar")))
+    for t in tars:
+        with tarfile.open(t) as tf:
+            pending = {}  # basename -> {"img": bytes, "txt": str}
+            for member in tf:
+                if not member.isfile():
+                    continue
+                base, ext = os.path.splitext(member.name)
+                ext = ext.lower()
+                if ext not in IMAGE_EXTS + (".txt",):
+                    continue
+                entry = pending.setdefault(base, {})
+                data = tf.extractfile(member).read()
+                if ext == ".txt":
+                    entry["txt"] = data.decode("utf-8", "replace").strip()
+                else:
+                    entry["img"] = data
+                if "img" in entry and "txt" in entry:
+                    del pending[base]
+                    yield entry["img"], entry["txt"]
+            # images whose .txt never appeared (or caption-less sets)
+            for entry in pending.values():
+                if "img" in entry:
+                    yield entry["img"], entry.get("txt", "")
+
+
 def iter_hf(name: str, image_key: str, caption_key: str):
     import datasets
     ds = datasets.load_dataset(name, split="train")
@@ -87,6 +124,21 @@ def main():
     os.makedirs(args.out, exist_ok=True)
     if args.src.startswith("hf:"):
         it = iter_hf(args.src[3:], args.image_key, args.caption_key)
+    elif args.src.endswith(".tar"):
+        it = iter_webdataset_tar(args.src)
+    elif os.path.isdir(args.src) and any(
+            f.endswith(".tar") for f in os.listdir(args.src)):
+        # tar mode only for a pure shard directory: a mixed directory is
+        # ambiguous (silently dropping the loose images would shrink the
+        # corpus with no warning), so make the user choose
+        loose = [f for f in os.listdir(args.src)
+                 if f.lower().endswith(IMAGE_EXTS)]
+        if loose:
+            raise SystemExit(
+                f"--src {args.src} holds both .tar shards and "
+                f"{len(loose)} loose image files; pass either a "
+                "directory of tars, a single .tar, or an image folder")
+        it = iter_webdataset_tar(args.src)
     else:
         it = iter_folder(args.src, args.caption_from_dirname)
 
@@ -95,19 +147,38 @@ def main():
         for i in range(args.shards)]
     counts = [0] * args.shards
     n = 0
-    for img, caption in it:
-        if args.image_size:
-            h, w = img.shape[:2]
-            s = args.image_size / min(h, w)
-            img = cv2.resize(img, (round(w * s), round(h * s)),
-                             interpolation=cv2.INTER_AREA)
-        ok, enc = cv2.imencode(".jpg", _rgb_to_bgr(img),
-                               [cv2.IMWRITE_JPEG_QUALITY, args.quality])
-        if not ok:
-            continue
+    for item, caption in it:
+        if isinstance(item, (bytes, bytearray)) and not args.image_size:
+            # already-encoded sample, no resize requested: write through
+            # verbatim (no re-encode generation loss); validity-check at
+            # 1/8 decode scale, which is cheap relative to a full decode
+            if cv2.imdecode(np.frombuffer(item, np.uint8),
+                            cv2.IMREAD_REDUCED_COLOR_8) is None:
+                continue
+            payload = bytes(item)
+        else:
+            img = item
+            if isinstance(item, (bytes, bytearray)):
+                img = cv2.imdecode(np.frombuffer(item, np.uint8),
+                                   cv2.IMREAD_COLOR)
+                if img is None:
+                    continue
+                img = img[..., ::-1]
+            if args.image_size:
+                h, w = img.shape[:2]
+                s = args.image_size / min(h, w)
+                img = cv2.resize(img, (round(w * s), round(h * s)),
+                                 interpolation=cv2.INTER_AREA)
+            ok, enc = cv2.imencode(".jpg", _rgb_to_bgr(img),
+                                   [cv2.IMWRITE_JPEG_QUALITY, args.quality])
+            if not ok:
+                continue
+            payload = enc.tobytes()
         shard = n % args.shards
-        writers[shard].write({"jpg": enc.tobytes(),
-                              "txt": caption.encode("utf-8")})
+        # canonical entry keys — the keys every DataSource decodes
+        # (decode_standard_record also accepts legacy jpg/txt packs)
+        writers[shard].write({"image": payload,
+                              "caption": caption.encode("utf-8")})
         counts[shard] += 1
         n += 1
         if n % 1000 == 0:

@@ -209,7 +209,90 @@ def test_pack_dataset_script_roundtrip(tmp_path):
     reader = PackedRecordReader(str(out / "shard-00000.pack"))
     assert len(reader) == 3
     rec = reader[0]
-    assert rec["txt"].decode() == "roses"
-    img = cv2.imdecode(np.frombuffer(rec["jpg"], np.uint8),
+    assert rec["caption"].decode() == "roses"
+    img = cv2.imdecode(np.frombuffer(rec["image"], np.uint8),
                        cv2.IMREAD_COLOR)
     assert img is not None and min(img.shape[:2]) == 16
+
+    # packed output must flow into the TRAINING loader, not just the raw
+    # reader (pre-r3 the script wrote jpg/txt keys no DataSource decoded)
+    from flaxdiff_tpu.data import MediaDataset, get_dataset_grain
+    from flaxdiff_tpu.data.packed_records import PackedRecordSource
+    from flaxdiff_tpu.data.sources.images import ImageAugmenter
+    ds = MediaDataset(source=PackedRecordSource(str(out / "shard-00000.pack")),
+                      augmenter=ImageAugmenter(image_size=16))
+    batch = next(get_dataset_grain(ds, batch_size=2, image_size=16)["train"]())
+    assert batch["sample"].shape == (2, 16, 16, 3)
+    assert all(t == "roses" for t in batch["text"])
+
+
+def test_pack_dataset_webdataset_tar(tmp_path):
+    """scripts/pack_dataset.py consumes img2dataset-style webdataset
+    .tar shards (image + sibling .txt caption per sample) — the handoff
+    scripts/datasets/download_corpus.sh relies on."""
+    import io
+    import json
+    import subprocess
+    import sys
+    import tarfile
+
+    import cv2
+
+    rng = np.random.default_rng(1)
+    wds = tmp_path / "webdataset"
+    wds.mkdir()
+    for shard in range(2):
+        with tarfile.open(wds / f"{shard:05d}.tar", "w") as tf:
+            for i in range(3):
+                img = rng.integers(0, 255, (24, 24, 3), np.uint8)
+                ok, enc = cv2.imencode(".jpg", img)
+                assert ok
+                for name, data in ((f"{shard}-{i}.jpg", enc.tobytes()),
+                                   (f"{shard}-{i}.txt",
+                                    f"caption {shard}-{i}".encode())):
+                    info = tarfile.TarInfo(name)
+                    info.size = len(data)
+                    tf.addfile(info, io.BytesIO(data))
+    out = tmp_path / "packed"
+    res = subprocess.run(
+        [sys.executable, "scripts/pack_dataset.py", "--src", str(wds),
+         "--out", str(out), "--shards", "2"],
+        capture_output=True, text=True, cwd=".")
+    assert res.returncode == 0, res.stderr
+    meta = json.loads(res.stdout.strip().splitlines()[-1])
+    assert meta["total"] == 6
+
+    from flaxdiff_tpu.data.packed_records import PackedRecordReader
+    texts = set()
+    for s in range(2):
+        reader = PackedRecordReader(str(out / f"shard-{s:05d}.pack"))
+        for i in range(len(reader)):
+            rec = reader[i]
+            texts.add(rec["caption"].decode())
+            img = cv2.imdecode(np.frombuffer(rec["image"], np.uint8),
+                               cv2.IMREAD_COLOR)
+            assert img is not None and img.shape == (24, 24, 3)
+    assert texts == {f"caption {s}-{i}" for s in range(2) for i in range(3)}
+
+
+def test_decode_standard_record_accepts_legacy_keys(tmp_path):
+    """Packs written with webdataset-style jpg/txt keys (pre-r3 script
+    output) still decode through every DataSource."""
+    import cv2
+
+    from flaxdiff_tpu.data.packed_records import (PackedRecordSource,
+                                                  PackedRecordWriter)
+    rng = np.random.default_rng(3)
+    path = str(tmp_path / "legacy.pack")
+    w = PackedRecordWriter(path)
+    for i in range(2):
+        ok, enc = cv2.imencode(
+            ".jpg", rng.integers(0, 255, (16, 16, 3), np.uint8))
+        assert ok
+        w.write({"jpg": enc.tobytes(), "txt": f"legacy {i}".encode()})
+    w.close()
+    src = PackedRecordSource(path).get_source()
+    assert len(src) == 2
+    rec = src[0]
+    assert rec["image"].shape == (16, 16, 3)
+    assert rec["text"] == "legacy 0"
