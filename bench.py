@@ -368,8 +368,6 @@ def stage_attnpad(args) -> dict:
     import jax
     import jax.numpy as jnp
 
-    from flaxdiff_tpu.ops.attention import dot_product_attention
-
     if jax.devices()[0].platform != "tpu":
         return {"platform": jax.devices()[0].platform,
                 "skipped": "flash kernel needs TPU"}
@@ -379,28 +377,18 @@ def stage_attnpad(args) -> dict:
     k = jax.random.normal(jax.random.PRNGKey(1), (B, L, H, D), jnp.bfloat16)
     v = jax.random.normal(jax.random.PRNGKey(2), (B, L, H, D), jnp.bfloat16)
 
-    def time_variant(backend, iters=50):
-        def loss(q, k, v):
-            return dot_product_attention(q, k, v, backend=backend).sum()
-        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-        # Chain each iteration's dq into the next q so no execution can be
-        # elided, and sync with a scalar readback — block_until_ready on
-        # the tunneled backend returned before completion (r3), "timing"
-        # this stage at 3x the chip's peak FLOP rate.
-        qi = q
-        float(jax.device_get(g(qi, k, v)[0].sum()))   # compile + sync
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            qi = g(qi, k, v)[0]
-        float(jax.device_get(qi.sum()))
-        return (time.perf_counter() - t0) / iters * 1e3   # ms
-
     res = {"platform": "tpu", "shape": [B, L, H, D]}
-    res["flash_padded_ms"] = round(time_variant("flash"), 3)
-    res["xla_d64_ms"] = round(time_variant("xla"), 3)
+    # this stage OWNS the native-d toggle: flashtune's exported winner
+    # may carry NATIVE_D=1, which would make the "padded" run silently
+    # measure the native kernel and zero out the very comparison this
+    # stage exists to make
+    os.environ.pop("FLAXDIFF_FLASH_NATIVE_D", None)
+    res["flash_padded_ms"] = round(chained_grad_ms("flash", q, k, v), 3)
+    res["xla_d64_ms"] = round(chained_grad_ms("xla", q, k, v), 3)
     try:
         os.environ["FLAXDIFF_FLASH_NATIVE_D"] = "1"
-        res["flash_native_d64_ms"] = round(time_variant("flash"), 3)
+        res["flash_native_d64_ms"] = round(
+            chained_grad_ms("flash", q, k, v), 3)
     except Exception as e:
         res["flash_native_d64_ms"] = None
         res["flash_native_error"] = f"{type(e).__name__}: {e}"
@@ -410,8 +398,95 @@ def stage_attnpad(args) -> dict:
     return res
 
 
-STAGES = {"sweep": stage_sweep, "ref": stage_ref, "ddim": stage_ddim,
-          "attnpad": stage_attnpad}
+def chained_grad_ms(backend: str, q0, k, v, iters: int = 30) -> float:
+    """Time one attention fwd+bwd via jit(grad): compile+sync first, then
+    `iters` steps with each iteration's dq fed into the next q (so no
+    execution can be elided), synced by a SCALAR READBACK —
+    block_until_ready on this tunneled backend returned before
+    completion (r3), "timing" micro-benches at 3x the chip's peak FLOP
+    rate. Shared by the flashtune and attnpad stages so their harness
+    stays identical and differences are kernel differences."""
+    import jax
+
+    from flaxdiff_tpu.ops.attention import dot_product_attention
+
+    def loss(q, k, v):
+        return dot_product_attention(q, k, v, backend=backend).sum()
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    qi = q0
+    float(jax.device_get(g(qi, k, v)[0].sum()))   # compile + sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        qi = g(qi, k, v)[0]
+    float(jax.device_get(qi.sum()))
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def stage_flashtune(args) -> dict:
+    """On-chip flash-kernel block-size sweep (runs FIRST; the winner is
+    exported to every later stage via FLAXDIFF_FLASH_BLOCK_Q/K and
+    FLAXDIFF_FLASH_NATIVE_D).
+
+    The r3 trace showed the kernel at ~7% in-step MFU with the old
+    128x128 blocks — per-program overhead dominated. Rather than bake a
+    guess, measure fwd+bwd on the flagship attention shape for a ladder
+    of block shapes (and native-d64 vs padded on the winner) and let the
+    rest of the bench run with the best combination."""
+    _apply_jax_platforms()
+    import jax
+    import jax.numpy as jnp
+
+    if jax.devices()[0].platform != "tpu":
+        return {"platform": jax.devices()[0].platform,
+                "skipped": "flash kernel needs TPU"}
+
+    B, L, H, D = 8, 1024, 8, 64   # flagship 32x32-latent level shape
+    q0 = jax.random.normal(jax.random.PRNGKey(0), (B, L, H, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, L, H, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, L, H, D), jnp.bfloat16)
+
+    def timed(bq, bk, native):
+        os.environ["FLAXDIFF_FLASH_BLOCK_Q"] = str(bq)
+        os.environ["FLAXDIFF_FLASH_BLOCK_K"] = str(bk)
+        if native:
+            os.environ["FLAXDIFF_FLASH_NATIVE_D"] = "1"
+        else:
+            os.environ.pop("FLAXDIFF_FLASH_NATIVE_D", None)
+        return chained_grad_ms("flash", q0, k, v)
+
+    combos = [(128, 128), (256, 512), (512, 512), (512, 1024),
+              (1024, 1024)]
+    results = {}
+    for bq, bk in combos:
+        try:
+            results[f"{bq}x{bk}"] = round(timed(bq, bk, native=False), 3)
+        except Exception as e:
+            results[f"{bq}x{bk}"] = f"{type(e).__name__}: {e}"[:120]
+        log(f"flashtune {bq}x{bk}: {results[f'{bq}x{bk}']}")
+    numeric = {kk: vv for kk, vv in results.items()
+               if isinstance(vv, float)}
+    if not numeric:
+        return {"platform": "tpu", "shape": [B, L, H, D],
+                "results_ms": results,
+                "skipped": "every combo failed"}
+    best_key = min(numeric, key=numeric.get)
+    bq, bk = (int(x) for x in best_key.split("x"))
+    best = {"block_q": bq, "block_k": bk, "native_d": 0,
+            "ms": numeric[best_key]}
+    try:
+        native_ms = round(timed(bq, bk, native=True), 3)
+        results[f"{best_key}+native_d"] = native_ms
+        log(f"flashtune {best_key}+native_d: {native_ms}")
+        if native_ms < best["ms"]:
+            best.update(native_d=1, ms=native_ms)
+    except Exception as e:
+        results[f"{best_key}+native_d"] = f"{type(e).__name__}: {e}"[:120]
+    return {"platform": "tpu", "shape": [B, L, H, D],
+            "results_ms": results, "best": best}
+
+
+STAGES = {"flashtune": stage_flashtune, "sweep": stage_sweep,
+          "ref": stage_ref, "ddim": stage_ddim, "attnpad": stage_attnpad}
 
 
 # ---------------------------------------------------------------------------
@@ -609,8 +684,10 @@ def main():
         emit(result, partial=False)
         raise SystemExit(1)
 
-    order = ["sweep", "ref", "ddim"] + ([] if args.quick else ["attnpad"])
-    timeouts = {"sweep": args.stage_timeout,
+    order = (["flashtune", "sweep", "ref", "ddim"]
+             + ([] if args.quick else ["attnpad"]))
+    timeouts = {"flashtune": max(args.stage_timeout // 3, 300),
+                "sweep": args.stage_timeout,
                 "ref": max(args.stage_timeout // 3, 300),
                 "ddim": max(args.stage_timeout // 2, 300),
                 "attnpad": max(args.stage_timeout // 3, 300)}
@@ -618,6 +695,15 @@ def main():
         log(f"=== stage {name} ===")
         result["stages"][name] = run_stage(
             name, args, env, timeouts[name], args.retries)
+        if name == "flashtune":
+            best = result["stages"][name].get("best")
+            if best:
+                # export the measured winner to every later stage
+                env["FLAXDIFF_FLASH_BLOCK_Q"] = str(best["block_q"])
+                env["FLAXDIFF_FLASH_BLOCK_K"] = str(best["block_k"])
+                if best.get("native_d"):
+                    env["FLAXDIFF_FLASH_NATIVE_D"] = "1"
+                log(f"flashtune winner exported: {best}")
         sweep = result["stages"].get("sweep", {})
         ref = result["stages"].get("ref", {})
         if sweep.get("status") == "ok":

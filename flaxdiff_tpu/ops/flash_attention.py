@@ -223,16 +223,38 @@ def _from_bh(x: jax.Array, b: int, h: int) -> jax.Array:
     return x.reshape(b, h, l, d).transpose(0, 2, 1, 3)
 
 
-def _block_sizes(lq: int, lk: int, block_q: int, block_k: int,
-                 interpret: bool):
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
+
+
+def _block_sizes(lq: int, lk: int, block_q: Optional[int],
+                 block_k: Optional[int], interpret: bool):
     """Effective block sizes. On TPU blocks stay lane-aligned (the caller
     pads head_dim; seq dims are padded here); in interpret mode small
-    test shapes shrink the blocks instead."""
+    test shapes shrink the blocks instead.
+
+    Defaults (block=None) are large — 512 q rows x 1024 kv rows, capped
+    at the padded sequence — because per-program overhead dominated at
+    128x128: the r3 trace showed the kernel at ~7% in-step MFU while the
+    jax reference TPU kernel uses 512/1024 blocks for exactly this
+    reason. Env overrides FLAXDIFF_FLASH_BLOCK_Q/K support on-chip
+    A/B tuning without a rebuild."""
+    import os
+    rq = -(-lq // LANES) * LANES   # padded seq lengths
+    rk = -(-lk // LANES) * LANES
+    # env only fills the None default — an explicitly-passed block size
+    # (tests, VMEM-bounded long-sequence callers) must win
+    if block_q is None:
+        env_q = os.environ.get("FLAXDIFF_FLASH_BLOCK_Q")
+        block_q = int(env_q) if env_q else min(DEFAULT_BLOCK_Q, rq)
+    if block_k is None:
+        env_k = os.environ.get("FLAXDIFF_FLASH_BLOCK_K")
+        block_k = int(env_k) if env_k else min(DEFAULT_BLOCK_K, rk)
     if interpret:
         bq = min(block_q, max(lq, 8))
         bk = min(block_k, max(lk, 8))
     else:
-        bq, bk = block_q, block_k
+        bq, bk = min(block_q, rq), min(block_k, rk)
     return bq, bk
 
 
@@ -357,15 +379,18 @@ def _bwd_impl(q, k, v, out_bh, lse, g, scale, block_q, block_k, interpret):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    scale: Optional[float] = None, block_q: int = 128,
-                    block_k: int = 128, interpret: bool = False) -> jax.Array:
+                    scale: Optional[float] = None,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
+                    interpret: bool = False) -> jax.Array:
     """Flash attention over [B, L, H, D] tensors (full fwd+bwd in Pallas).
 
     head_dim must be a multiple of 8 on real TPU — multiples of 128 use
     full lanes; narrower dims are handled natively (Mosaic masks the
     sub-128 lanes) when the dispatch layer passes them through
     (FLAXDIFF_FLASH_NATIVE_D=1) and zero-padded to 128 otherwise.
-    Sequence dims are padded internally.
+    Sequence dims are padded internally. block_q/block_k default to
+    large sequence-capped blocks (see _block_sizes).
     """
     out, _ = _fwd_impl(q, k, v, scale, block_q, block_k, interpret)
     b, lq, h, _ = q.shape
