@@ -51,6 +51,18 @@ class RngSeq:
 RandomMarkovState = RngSeq
 
 
+def apply_jax_platforms_env() -> None:
+    """Honor JAX_PLATFORMS even when a site hook imported jax at
+    interpreter startup with another platform latched (the env var alone
+    is then too late — observed on this build VM's tunneled-TPU image).
+    Call before the first device access. Shared by train.py, bench
+    stages, and tests/conftest.py."""
+    import os
+    p = os.environ.get("JAX_PLATFORMS")
+    if p:
+        jax.config.update("jax_platforms", p)
+
+
 def normalize_images(x: jax.Array) -> jax.Array:
     """uint8 [0,255] -> float [-1,1] (reference: general_diffusion_trainer.py:258)."""
     return (x.astype(jnp.float32) - 127.5) / 127.5

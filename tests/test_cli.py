@@ -87,3 +87,50 @@ def test_cli_trains_video_with_audio_conditioning(tmp_path, make_av_file):
         "--text_encoder", "none", "--batch_size", "8",
         "--log_every", "1")
     assert np.isfinite(hist["final_loss"])
+
+
+def test_cli_latent_diffusion_with_autoencoder(tmp_path):
+    """--autoencoder trains the prior in codec latent space (reference
+    training.py:192-195,339-345): the UNet's sample shape shrinks by the
+    codec's downscale and widens to its latent channels; validation
+    decodes back to pixel space."""
+    hist = _run(
+        tmp_path, "--dataset", "synthetic",
+        "--autoencoder", "kl_vae",
+        "--autoencoder_opts", json.dumps({
+            "block_channels": [8, 16], "latent_channels": 4,
+            "norm_groups": 4, "layers_per_block": 1}),
+        "--val_every", "3", "--val_samples", "4", "--val_steps", "2",
+        "--val_metrics", "psnr")
+    assert np.isfinite(hist["final_loss"])
+    cfg = json.load(open(tmp_path / "ckpt" / "pipeline_config.json"))
+    assert cfg["autoencoder"]["name"] == "kl_vae"
+    assert cfg["autoencoder"]["latent_channels"] == 4
+    assert cfg["model"]["output_channels"] == 4
+
+
+def test_cli_latent_diffusion_sd_vae_npz(tmp_path):
+    """--autoencoder sd_vae with converted pretrained weights loaded
+    from the npz the converter script writes."""
+    import jax
+
+    from flaxdiff_tpu.models.sd_vae import SDVAE
+    vae = SDVAE.create(jax.random.PRNGKey(0), block_out_channels=(8, 8),
+                       norm_groups=4, layers_per_block=1, image_size=16)
+    flat = {}
+
+    def _walk(tree, prefix):
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                _walk(v, f"{prefix}{k}/")
+            else:
+                flat[f"{prefix}{k}"] = np.asarray(v)
+    _walk(vae.params, "")
+    npz = tmp_path / "sd_vae.npz"
+    np.savez(npz, **flat)
+    hist = _run(
+        tmp_path, "--dataset", "synthetic",
+        "--autoencoder", "sd_vae",
+        "--autoencoder_opts", json.dumps({"npz": str(npz),
+                                          "norm_groups": 4}))
+    assert np.isfinite(hist["final_loss"])

@@ -42,6 +42,19 @@ def parse_args(argv=None):
                    help="registry name, e.g. unet, simple_dit+hilbert")
     p.add_argument("--model_config", default="{}",
                    help="JSON kwargs for the model constructor")
+    p.add_argument("--autoencoder", default=None,
+                   choices=["identity", "kl_vae", "sd_vae",
+                            "stable_diffusion"],
+                   help="latent-diffusion codec: the prior trains in the "
+                        "codec's latent space and validation decodes "
+                        "(reference training.py:192-195,339-345)")
+    p.add_argument("--autoencoder_opts", default="{}",
+                   help='JSON codec opts. sd_vae: {"npz": "sd_vae.npz"} '
+                        "loads converted pretrained weights "
+                        "(scripts/convert_sd_vae_weights.py); kl_vae/"
+                        "sd_vae without weights init randomly (smoke "
+                        "runs); stable_diffusion passes through to the "
+                        "diffusers wrapper")
     p.add_argument("--dtype", default="bfloat16")
     # diffusion
     p.add_argument("--schedule", default="cosine")
@@ -105,6 +118,9 @@ def main(argv=None):
     args = parse_args(argv)
 
     import jax
+
+    from flaxdiff_tpu.utils import apply_jax_platforms_env
+    apply_jax_platforms_env()
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -190,9 +206,43 @@ def main(argv=None):
                                    read_buffer_size=args.grain_read_buffer,
                                    seed=args.seed)
 
+    # latent-diffusion codec (reference training.py:339-345): the prior
+    # below trains over its latents — the encode happens INSIDE the
+    # jitted train step, decode inside the validation sampler
+    autoencoder = None
+    if args.autoencoder:
+        ae_opts = json.loads(args.autoencoder_opts)
+        if args.autoencoder == "sd_vae" and "npz" in ae_opts:
+            from flaxdiff_tpu.models.sd_vae import SDVAE
+            autoencoder = SDVAE.from_npz(ae_opts.pop("npz"), **ae_opts)
+        else:
+            from flaxdiff_tpu.models.autoencoder import AUTOENCODER_REGISTRY
+            builder = AUTOENCODER_REGISTRY[args.autoencoder]
+            if args.autoencoder == "kl_vae":
+                autoencoder = builder.create(
+                    jax.random.PRNGKey(ae_opts.pop("seed", 0)), **ae_opts)
+            else:
+                autoencoder = builder(**ae_opts)
+        if args.image_size % autoencoder.downscale_factor:
+            raise SystemExit(
+                f"--image_size {args.image_size} is not divisible by the "
+                f"{autoencoder.name} codec's downscale factor "
+                f"{autoencoder.downscale_factor}; the encoder would "
+                "produce ceil-sized latents that disagree with the "
+                "prior's sample shape")
+        print(f"latent diffusion via {autoencoder.name}: "
+              f"{autoencoder.downscale_factor}x downscale, "
+              f"{autoencoder.latent_channels} latent channels")
+
+    sample_channels = (autoencoder.latent_channels if autoencoder else 3)
+    sample_size = (args.image_size // autoencoder.downscale_factor
+                   if autoencoder else args.image_size)
+
     # model
     model_kwargs = json.loads(args.model_config)
     model_kwargs.setdefault("dtype", args.dtype)
+    if autoencoder is not None:
+        model_kwargs.setdefault("output_channels", sample_channels)
     model = build_model(args.architecture, **model_kwargs)
 
     schedule = get_schedule(args.schedule, timesteps=args.timesteps)
@@ -211,10 +261,10 @@ def main(argv=None):
         ctx_shape = (args.num_frames, audio_enc.features)
 
     if args.num_frames:
-        x0 = jnp.zeros((2, args.num_frames, args.image_size,
-                        args.image_size, 3))
+        x0 = jnp.zeros((2, args.num_frames, sample_size,
+                        sample_size, sample_channels))
     else:
-        x0 = jnp.zeros((2, args.image_size, args.image_size, 3))
+        x0 = jnp.zeros((2, sample_size, sample_size, sample_channels))
     t0 = jnp.zeros((2,))
     c0 = (jnp.zeros((2,) + ctx_shape) if ctx_shape else None)
 
@@ -315,7 +365,8 @@ def main(argv=None):
                              uncond_prob=args.uncond_prob,
                              log_every=args.log_every, seed=args.seed,
                              profile_dir=args.profile_dir),
-        policy=policy, null_cond=null_cond, checkpointer=ckpt)
+        policy=policy, null_cond=null_cond, checkpointer=ckpt,
+        autoencoder=autoencoder)
 
     if ckpt.latest_step() is not None:
         step = trainer.restore_checkpoint()
@@ -327,6 +378,12 @@ def main(argv=None):
         "schedule": {"name": args.schedule, "timesteps": args.timesteps},
         "predictor": args.predictor,
         "input_config": (input_config.serialize() if conditions else None),
+        # informational: inference must supply the codec object itself
+        # (weights live outside the checkpoint), but the config records
+        # which codec and shape the prior was trained against
+        "autoencoder": ({"name": args.autoencoder,
+                         **autoencoder.serialize()}
+                        if autoencoder else None),
     })
 
     validator = None
@@ -354,7 +411,7 @@ def main(argv=None):
         validator = Validator(
             model_fn=apply_fn, schedule=schedule, transform=transform,
             sampler=SAMPLER_REGISTRY[args.sampler](),
-            metrics=val_metrics,
+            metrics=val_metrics, autoencoder=autoencoder,
             config=ValidationConfig(
                 num_samples=args.val_samples,
                 diffusion_steps=args.val_steps,
