@@ -75,12 +75,9 @@ def log(*a):
 # ---------------------------------------------------------------------------
 
 def _apply_jax_platforms():
-    # honor JAX_PLATFORMS even if a site hook latched another platform at
-    # interpreter startup (same workaround as tests/conftest.py)
-    p = os.environ.get("JAX_PLATFORMS")
-    if p:
-        import jax
-        jax.config.update("jax_platforms", p)
+    # stage children may import the package; the parent never does
+    from flaxdiff_tpu.utils import apply_jax_platforms_env
+    apply_jax_platforms_env()
 
 
 def build_trainer(tpu_native: bool, image_size: int = IMAGE_SIZE,
@@ -485,8 +482,64 @@ def stage_flashtune(args) -> dict:
             "results_ms": results, "best": best}
 
 
+def stage_ablate(args) -> dict:
+    """In-context kernel ablation at the headline batch: flash vs XLA
+    attention x pallas vs XLA GroupNorm+SiLU, full train step.
+
+    Micro-benches (flashtune/attnpad) time kernels in isolation; this
+    stage answers the question that actually matters — do the custom
+    kernels beat XLA *inside the compiled train step*, where the r3
+    trace showed ~750 layout copies/step clustered around the pallas
+    custom calls. If an XLA variant wins here, that is the next round's
+    default."""
+    _apply_jax_platforms()
+    import jax
+
+    if jax.devices()[0].platform != "tpu":
+        return {"platform": jax.devices()[0].platform,
+                "skipped": "kernel ablation needs TPU"}
+
+    timed = 20
+    # ablate at the sweep's winning batch (the orchestrator exports it —
+    # kernel-vs-XLA tradeoffs like layout-copy overhead scale with
+    # batch, so measuring at a different batch than the headline would
+    # answer the wrong question); standalone runs default to baseline
+    batch = int(os.environ.get("FLAXDIFF_BENCH_ABLATE_BATCH",
+                               BASELINE_BATCH))
+    res = {"platform": "tpu", "batch": batch,
+           "image_size": IMAGE_SIZE, "configs": {}}
+    for attn_backend in ("flash", "xla"):
+        for norm in ("pallas", "xla"):
+            key = f"attn={attn_backend},norm={norm}"
+            if norm == "xla":
+                os.environ["FLAXDIFF_FUSED_NORM"] = "xla"
+            else:
+                os.environ.pop("FLAXDIFF_FUSED_NORM", None)
+            try:
+                trainer = build_trainer(tpu_native=True,
+                                        attn_backend=attn_backend)
+                ips, step_time, _ = run(
+                    trainer, make_batches(batch), batch,
+                    sync_every_step=False, timed_steps=timed)
+                res["configs"][key] = {
+                    "imgs_per_sec_per_chip": round(ips, 3),
+                    "step_time_ms": round(step_time * 1e3, 2)}
+                del trainer
+            except Exception as e:
+                res["configs"][key] = {
+                    "error": f"{type(e).__name__}: {e}"[:160]}
+            log(f"ablate {key}: {res['configs'][key]}")
+    os.environ.pop("FLAXDIFF_FUSED_NORM", None)
+    ok = {kk: vv for kk, vv in res["configs"].items()
+          if "imgs_per_sec_per_chip" in vv}
+    if ok:
+        res["best"] = max(ok, key=lambda kk: ok[kk]["imgs_per_sec_per_chip"])
+    return res
+
+
 STAGES = {"flashtune": stage_flashtune, "sweep": stage_sweep,
-          "ref": stage_ref, "ddim": stage_ddim, "attnpad": stage_attnpad}
+          "ref": stage_ref, "ddim": stage_ddim, "attnpad": stage_attnpad,
+          "ablate": stage_ablate}
 
 
 # ---------------------------------------------------------------------------
@@ -685,12 +738,13 @@ def main():
         raise SystemExit(1)
 
     order = (["flashtune", "sweep", "ref", "ddim"]
-             + ([] if args.quick else ["attnpad"]))
+             + ([] if args.quick else ["attnpad", "ablate"]))
     timeouts = {"flashtune": max(args.stage_timeout // 3, 300),
                 "sweep": args.stage_timeout,
                 "ref": max(args.stage_timeout // 3, 300),
                 "ddim": max(args.stage_timeout // 2, 300),
-                "attnpad": max(args.stage_timeout // 3, 300)}
+                "attnpad": max(args.stage_timeout // 3, 300),
+                "ablate": max(args.stage_timeout // 2, 600)}
     for name in order:
         log(f"=== stage {name} ===")
         result["stages"][name] = run_stage(
@@ -704,6 +758,10 @@ def main():
                 if best.get("native_d"):
                     env["FLAXDIFF_FLASH_NATIVE_D"] = "1"
                 log(f"flashtune winner exported: {best}")
+        if name == "sweep" and result["stages"][name].get("batch_per_chip"):
+            # ablate measures at the headline batch, not a fixed one
+            env["FLAXDIFF_BENCH_ABLATE_BATCH"] = str(
+                result["stages"][name]["batch_per_chip"])
         sweep = result["stages"].get("sweep", {})
         ref = result["stages"].get("ref", {})
         if sweep.get("status") == "ok":

@@ -17,6 +17,7 @@ backward kernel is a later optimization). Falls back to XLA off-TPU.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -104,6 +105,12 @@ def _impl(x: jax.Array, scale: jax.Array, bias: jax.Array,
 
     on_tpu = jax.devices()[0].platform == "tpu"
     if not force_pallas and not (on_tpu or interpret):
+        return _xla_groupnorm_silu(x, scale, bias, groups, eps, apply_silu)
+    if not force_pallas and os.environ.get("FLAXDIFF_FUSED_NORM") == "xla":
+        # A/B escape hatch: the r3 trace showed ~750 layout copies/step
+        # around the pallas custom calls — the bench's ablate stage uses
+        # this to measure whether the fused kernel pays for its copies
+        # in-context on real hardware
         return _xla_groupnorm_silu(x, scale, bias, groups, eps, apply_silu)
 
     xr = x.reshape(b, -1, c)
