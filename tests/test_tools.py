@@ -1,0 +1,98 @@
+"""Dev-tooling coverage: trace analyzer + bench stage CPU guards."""
+import argparse
+import gzip
+import json
+import time
+
+import pytest
+
+
+def _write_trace(path, events):
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+DEVICE_EVENTS = [
+    {"ph": "M", "name": "process_name", "pid": 3,
+     "args": {"name": "/device:TPU:0"}},
+    {"ph": "M", "name": "process_name", "pid": 9,
+     "args": {"name": "/host:CPU"}},
+    {"ph": "X", "pid": 3, "name": "attn1.2", "dur": 4000},
+    {"ph": "X", "pid": 3, "name": "attn1.3", "dur": 2000},
+    {"ph": "X", "pid": 3, "name": "fusion.7", "dur": 1000},
+    {"ph": "X", "pid": 3, "name": "jit_train_step(123)", "dur": 99999},
+    {"ph": "X", "pid": 9, "name": "host_only_thing", "dur": 5000},
+]
+
+
+def test_analyze_trace_aggregates_device_ops(tmp_path, capsys):
+    from scripts.analyze_trace import main
+    d = tmp_path / "plugins" / "profile" / "t1"
+    d.mkdir(parents=True)
+    _write_trace(d / "vm.trace.json.gz", DEVICE_EVENTS)
+    assert main([str(tmp_path), "--top", "5"]) == 0
+    out = capsys.readouterr().out
+    assert "/device:TPU:0" in out
+    assert "7.00 ms" in out   # total: 6 ms attn + 1 ms fusion
+    # the attn FAMILY row aggregates attn1.2 + attn1.3 into 6.00 ms —
+    # a falsifiable check that family() strips the SSA counter
+    attn_rows = [ln for ln in out.splitlines()
+                 if ln.startswith("attn")]
+    assert len(attn_rows) == 1 and "6.00" in attn_rows[0], attn_rows
+    assert "jit_train_step" not in out and "host_only_thing" not in out
+
+
+def test_analyze_trace_skips_corrupt_and_host_only(tmp_path, capsys):
+    """Newest capture truncated, next host-only, oldest good: the good
+    one must be chosen (the wedged-tunnel scenario)."""
+    from scripts.analyze_trace import main
+    base = tmp_path / "plugins" / "profile"
+    good = base / "2020_01_01"
+    hostonly = base / "2021_01_01"
+    corrupt = base / "2022_01_01"
+    for d in (good, hostonly, corrupt):
+        d.mkdir(parents=True)
+    _write_trace(good / "vm.trace.json.gz", DEVICE_EVENTS)
+    _write_trace(hostonly / "vm.trace.json.gz", [
+        {"ph": "M", "name": "process_name", "pid": 9,
+         "args": {"name": "/host:CPU"}},
+        {"ph": "X", "pid": 9, "name": "x", "dur": 1}])
+    with gzip.open(hostonly / "vm.trace.json.gz", "rb") as f:
+        blob = f.read(40)
+    (corrupt / "vm.trace.json.gz").write_bytes(blob)  # truncated gz
+    assert main([str(tmp_path)]) == 0
+    assert "2020_01_01" in capsys.readouterr().out
+
+
+def test_analyze_trace_reports_host_only(tmp_path):
+    from scripts.analyze_trace import main
+    d = tmp_path / "p"
+    d.mkdir()
+    _write_trace(d / "vm.trace.json.gz", [
+        {"ph": "M", "name": "process_name", "pid": 9,
+         "args": {"name": "/host:CPU"}}])
+    with pytest.raises(SystemExit, match="no device timeline"):
+        main([str(d)])
+
+
+def test_tpu_only_bench_stages_skip_on_cpu():
+    """flashtune/attnpad/ablate must refuse to fake numbers off-TPU."""
+    import bench
+    args = argparse.Namespace(trace="bench_trace", quick=False)
+    for stage in (bench.stage_flashtune, bench.stage_attnpad,
+                  bench.stage_ablate):
+        out = stage(args)
+        assert out["platform"] == "cpu" and "skipped" in out
+
+
+def test_chained_grad_ms_runs_on_cpu():
+    """The shared timing harness itself is backend-agnostic."""
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 128, 2, 16),
+                          jnp.float32)
+    t0 = time.perf_counter()
+    ms = bench.chained_grad_ms("xla", q, q, q, iters=2)
+    assert 0 < ms < (time.perf_counter() - t0) * 1e3
