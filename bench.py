@@ -42,8 +42,8 @@ the final result:
 Flags:
   --trace DIR    profiler-trace dir (default ./bench_trace, always captured)
   --quick        single batch size, fewer steps (CI smoke)
-  --probe_timeout S   per-attempt backend probe timeout (default 120)
-  --probe_budget S    total probe budget across retries (default 900)
+  --probe_timeout S   per-attempt backend probe timeout (default 600)
+  --probe_budget S    total probe budget across retries (default 3600)
   --stage_timeout S   per-stage subprocess timeout (default 2700)
   --retries N         per-stage retry count (default 2)
   --no_cpu_fallback   report tpu-unavailable instead of CPU numbers
@@ -686,7 +686,10 @@ def main():
     # healthy init is seconds, but the tunnel needs ~10-20 min to shed a
     # leaked lease after any killed client — be patient, don't churn
     ap.add_argument("--probe_timeout", type=int, default=600)
-    ap.add_argument("--probe_budget", type=int, default=1800)
+    # spans two full wedge-recovery cycles (observed ~10-20 min each):
+    # the round-end run is the one shot at hardware evidence, so waiting
+    # an hour beats falling back to CPU fifteen minutes too early
+    ap.add_argument("--probe_budget", type=int, default=3600)
     ap.add_argument("--stage_timeout", type=int, default=2700)
     ap.add_argument("--retries", type=int, default=2)
     ap.add_argument("--no_cpu_fallback", action="store_true")
