@@ -537,9 +537,52 @@ def stage_ablate(args) -> dict:
     return res
 
 
+def stage_longseq(args) -> dict:
+    """Long-context attention on hardware: flash fwd+bwd at 8k/16k/32k
+    tokens, XLA attempted at the same shapes for contrast.
+
+    The flash kernel's VMEM use is O(block) in sequence length while XLA
+    attention materializes the [L, L] score matrix — at 16k tokens that
+    is 1 GiB f32 per (batch, head) slice, so XLA is expected to fail
+    where flash keeps running. This stage turns the long-context design
+    claim (SURVEY aux: ring/sequence parallelism rests on the same
+    blockwise kernel) into an on-chip number."""
+    _apply_jax_platforms()
+    import jax
+    import jax.numpy as jnp
+
+    if jax.devices()[0].platform != "tpu":
+        return {"platform": jax.devices()[0].platform,
+                "skipped": "needs TPU"}
+
+    H, D = 8, 64
+    res = {"platform": "tpu", "heads": H, "head_dim": D, "lengths": {}}
+    for L in (8192, 16384, 32768):
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, L, H, D),
+                              jnp.bfloat16)
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, L, H, D),
+                              jnp.bfloat16)
+        v = jax.random.normal(jax.random.PRNGKey(2), (1, L, H, D),
+                              jnp.bfloat16)
+        entry = {}
+        for backend in ("flash", "xla"):
+            try:
+                entry[f"{backend}_ms"] = round(
+                    chained_grad_ms(backend, q, k, v, iters=10), 3)
+            except Exception as e:
+                entry[f"{backend}_ms"] = None
+                entry[f"{backend}_error"] = \
+                    f"{type(e).__name__}: {e}"[:160]
+        res["lengths"][str(L)] = entry
+        log(f"longseq L={L}: {entry}")
+        if entry.get("flash_ms") is None:
+            break   # flash itself out of memory: longer L is pointless
+    return res
+
+
 STAGES = {"flashtune": stage_flashtune, "sweep": stage_sweep,
           "ref": stage_ref, "ddim": stage_ddim, "attnpad": stage_attnpad,
-          "ablate": stage_ablate}
+          "ablate": stage_ablate, "longseq": stage_longseq}
 
 
 # ---------------------------------------------------------------------------
@@ -741,13 +784,14 @@ def main():
         raise SystemExit(1)
 
     order = (["flashtune", "sweep", "ref", "ddim"]
-             + ([] if args.quick else ["attnpad", "ablate"]))
+             + ([] if args.quick else ["attnpad", "ablate", "longseq"]))
     timeouts = {"flashtune": max(args.stage_timeout // 3, 300),
                 "sweep": args.stage_timeout,
                 "ref": max(args.stage_timeout // 3, 300),
                 "ddim": max(args.stage_timeout // 2, 300),
                 "attnpad": max(args.stage_timeout // 3, 300),
-                "ablate": max(args.stage_timeout // 2, 600)}
+                "ablate": max(args.stage_timeout // 2, 600),
+                "longseq": max(args.stage_timeout // 3, 300)}
     for name in order:
         log(f"=== stage {name} ===")
         result["stages"][name] = run_stage(

@@ -80,7 +80,7 @@ def test_tpu_only_bench_stages_skip_on_cpu():
     import bench
     args = argparse.Namespace(trace="bench_trace", quick=False)
     for stage in (bench.stage_flashtune, bench.stage_attnpad,
-                  bench.stage_ablate):
+                  bench.stage_ablate, bench.stage_longseq):
         out = stage(args)
         assert out["platform"] == "cpu" and "skipped" in out
 
