@@ -16,6 +16,7 @@ from .context import (
     use_mesh,
 )
 from .mesh import MeshAxes, create_mesh, local_batch_size, mesh_shape_for
+from .pipeline import pipeline_blocks, stack_block_params
 from .ring_attention import (
     ring_attention_sharded,
     ring_self_attention,
@@ -43,8 +44,10 @@ __all__ = [
     "seq_parallel_active",
     "set_active_mesh",
     "use_mesh",
+    "pipeline_blocks",
     "ring_attention_sharded",
     "ring_self_attention",
+    "stack_block_params",
     "ulysses_attention_sharded",
     "ulysses_self_attention",
     "sequence_sharding",
