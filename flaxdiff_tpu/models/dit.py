@@ -98,48 +98,69 @@ class SimpleDiT(nn.Module):
     use_zigzag: bool = False
     activation: Callable = jax.nn.gelu   # MLP nonlinearity inside DiTBlocks
 
-    @nn.compact
-    def __call__(self, x: jax.Array, temb: jax.Array,
-                 textcontext: Optional[jax.Array] = None) -> jax.Array:
+    def setup(self):
         if self.use_hilbert and self.use_zigzag:
             raise ValueError("use_hilbert and use_zigzag are mutually exclusive")
-        B, H, W, C = x.shape
-        p = self.patch_size
-        num_patches = (H // p) * (W // p)
         scan_order = ("hilbert" if self.use_hilbert
                       else "zigzag" if self.use_zigzag else "raster")
-
-        tokens, inv_idx = ScanPatchEmbed(
-            patch_size=p, embedding_dim=self.emb_features,
+        self._scan_order = scan_order
+        self.embed = ScanPatchEmbed(
+            patch_size=self.patch_size, embedding_dim=self.emb_features,
             scan_order=scan_order, dtype=self.dtype,
-            precision=self.precision, name="embed")(x)
-        cond = TimeTextEmbedding(
+            precision=self.precision, name="embed")
+        self.cond_embed = TimeTextEmbedding(
             features=self.emb_features, mlp_ratio=self.mlp_ratio,
-            dtype=self.dtype, precision=self.precision,
-            name="cond")(temb, textcontext)
-        freqs = scan_rope(self.emb_features // self.num_heads, num_patches,
-                          scan_order)
-
+            dtype=self.dtype, precision=self.precision, name="cond")
         # nn.remat = jax.checkpoint per block: recompute activations in
         # the backward pass instead of holding depth x tokens in HBM
         BlockCls = nn.remat(DiTBlock) if self.remat else DiTBlock
-        for i in range(self.num_layers):
-            tokens = BlockCls(
-                features=self.emb_features, num_heads=self.num_heads,
-                mlp_ratio=self.mlp_ratio, backend=self.backend,
-                dtype=self.dtype, precision=self.precision,
-                force_fp32_for_softmax=self.force_fp32_for_softmax,
-                norm_epsilon=self.norm_epsilon, activation=self.activation,
-                name=f"block_{i}")(tokens, cond, freqs)
+        self.blocks = [BlockCls(
+            features=self.emb_features, num_heads=self.num_heads,
+            mlp_ratio=self.mlp_ratio, backend=self.backend,
+            dtype=self.dtype, precision=self.precision,
+            force_fp32_for_softmax=self.force_fp32_for_softmax,
+            norm_epsilon=self.norm_epsilon, activation=self.activation,
+            name=f"block_{i}") for i in range(self.num_layers)]
+        self.final_norm = nn.LayerNorm(
+            epsilon=self.norm_epsilon, dtype=jnp.float32, name="final_norm")
+        out_dim = (self.patch_size ** 2 * self.output_channels
+                   * (2 if self.learn_sigma else 1))
+        self.final_proj = nn.Dense(
+            out_dim, dtype=jnp.float32, kernel_init=nn.initializers.zeros,
+            name="final_proj")
 
-        tokens = nn.LayerNorm(epsilon=self.norm_epsilon, dtype=jnp.float32,
-                              name="final_norm")(tokens)
-        out_dim = p * p * self.output_channels * (2 if self.learn_sigma else 1)
-        tokens = nn.Dense(out_dim, dtype=jnp.float32,
-                          kernel_init=nn.initializers.zeros,
-                          name="final_proj")(tokens)
+    def head(self, x: jax.Array, temb: jax.Array,
+             textcontext: Optional[jax.Array] = None):
+        """Patch-embed + conditioning + RoPE tables — everything before
+        the transformer trunk. Exposed as an apply method so
+        parallel.pipeline.pipelined_dit_apply reuses the model's own
+        code around a pipelined trunk."""
+        p = self.patch_size
+        num_patches = (x.shape[1] // p) * (x.shape[2] // p)
+        tokens, inv_idx = self.embed(x)
+        cond = self.cond_embed(temb, textcontext)
+        freqs = scan_rope(self.emb_features // self.num_heads,
+                          num_patches, self._scan_order)
+        return tokens, cond, freqs, inv_idx
+
+    def tail(self, tokens: jax.Array, inv_idx: Optional[jax.Array],
+             height: int, width: int) -> jax.Array:
+        """Final norm/projection + unpatchify — everything after the
+        transformer trunk."""
+        p = self.patch_size
+        tokens = self.final_norm(tokens)
+        tokens = self.final_proj(tokens)
         if self.learn_sigma:
             tokens, _logvar = jnp.split(tokens, 2, axis=-1)
         if inv_idx is not None:
-            return sfc_unpatchify(tokens, inv_idx, p, H, W, self.output_channels)
-        return unpatchify(tokens, p, H, W, self.output_channels)
+            return sfc_unpatchify(tokens, inv_idx, p, height, width,
+                                  self.output_channels)
+        return unpatchify(tokens, p, height, width, self.output_channels)
+
+    def __call__(self, x: jax.Array, temb: jax.Array,
+                 textcontext: Optional[jax.Array] = None) -> jax.Array:
+        B, H, W, C = x.shape
+        tokens, cond, freqs, inv_idx = self.head(x, temb, textcontext)
+        for block in self.blocks:
+            tokens = block(tokens, cond, freqs)
+        return self.tail(tokens, inv_idx, H, W)

@@ -16,7 +16,11 @@ from .context import (
     use_mesh,
 )
 from .mesh import MeshAxes, create_mesh, local_batch_size, mesh_shape_for
-from .pipeline import pipeline_blocks, stack_block_params
+from .pipeline import (
+    pipeline_blocks,
+    pipelined_dit_apply,
+    stack_block_params,
+)
 from .ring_attention import (
     ring_attention_sharded,
     ring_self_attention,
@@ -45,6 +49,7 @@ __all__ = [
     "set_active_mesh",
     "use_mesh",
     "pipeline_blocks",
+    "pipelined_dit_apply",
     "ring_attention_sharded",
     "ring_self_attention",
     "stack_block_params",

@@ -133,3 +133,45 @@ def pipeline_blocks(block_fn: Callable[[PyTree, jax.Array, Any], jax.Array],
         fn = shard_map(_shard, check_rep=False, **kwargs)
     outs = fn(stacked_params, xs, conds)
     return outs.reshape(batch, *x.shape[1:])
+
+
+def pipelined_dit_apply(dit, params: PyTree, x: jax.Array,
+                        temb: jax.Array,
+                        textcontext: Optional[jax.Array],
+                        mesh: Mesh,
+                        axis: str = "pipe",
+                        num_microbatches: Optional[int] = None,
+                        data_axis: Optional[str] = "data",
+                        remat: bool = True) -> jax.Array:
+    """Apply a `SimpleDiT` with its transformer trunk pipelined.
+
+    Takes the params of a NORMALLY-initialized SimpleDiT, restacks the
+    homogeneous `block_i` entries into the pipeline layout, and runs
+    the model's OWN head/tail methods (patch-embed + conditioning /
+    final layers — a tiny share of the FLOPs) replicated around the
+    pipelined trunk, so existing checkpoints pipeline without re-init
+    and the head/tail code has one source of truth. Numerically matches
+    `dit.apply` (tests/test_pipeline.py)."""
+    from ..models.dit import DiTBlock
+
+    B, H, W, _ = x.shape
+    tokens, cond, freqs, inv_idx = dit.apply(
+        {"params": params}, x, temb, textcontext, method="head")
+
+    block = DiTBlock(
+        features=dit.emb_features, num_heads=dit.num_heads,
+        mlp_ratio=dit.mlp_ratio, backend=dit.backend, dtype=dit.dtype,
+        precision=dit.precision,
+        force_fp32_for_softmax=dit.force_fp32_for_softmax,
+        norm_epsilon=dit.norm_epsilon, activation=dit.activation)
+    stacked = stack_block_params(
+        [params[f"block_{i}"] for i in range(dit.num_layers)])
+
+    tokens = pipeline_blocks(
+        lambda bp, h, c: block.apply({"params": bp}, h, c, freqs),
+        stacked, tokens, cond, mesh, axis=axis,
+        num_microbatches=num_microbatches, data_axis=data_axis,
+        remat=remat)
+
+    return dit.apply({"params": params}, tokens, inv_idx, H, W,
+                     method="tail")
