@@ -62,3 +62,9 @@ def test_ring_attention_example():
 def test_inpainting_example():
     hist = _run_example("08_inpainting.py")
     assert np.isfinite(hist["final_loss"])
+
+
+def test_pipeline_parallel_example():
+    hist = _run_example("09_pipeline_parallel.py")
+    assert np.isfinite(hist["final_loss"])
+    assert hist["drift"] < 1e-3
