@@ -10,6 +10,7 @@ and no per-step host sync (loss is read back only at the log cadence).
 from .autoencoder_trainer import AutoEncoderTrainer, AutoEncoderTrainerConfig
 from .checkpoints import Checkpointer, abstract_state_like
 from .logging import JsonlLogger, MultiLogger, WandbLogger, make_logger, save_image_grid
+from .optim import flat_optimizer
 from .registry import ModelRegistry
 from .train_state import TrainState
 from .train_step import TrainStepConfig, make_train_step
@@ -18,6 +19,7 @@ from .validation import ValidationConfig, Validator
 
 __all__ = [
     "TrainState",
+    "flat_optimizer",
     "TrainStepConfig",
     "make_train_step",
     "DiffusionTrainer",

@@ -1,0 +1,93 @@
+"""Flat-parameter optimizer wrapper: one fused update per dtype.
+
+The r3 on-chip trace attributed ~10 ms of the 83 ms train step to
+~330 `multiply_add_fusion` kernels — the leaf-wise optimizer + EMA
+updates, running at ~5x the HBM floor because each small leaf pays a
+kernel launch. Elementwise optimizers (adam/adamw/sgd/lion — any optax
+chain that treats every parameter pointwise) are invariant to
+reshaping and concatenation, so running the SAME transform over one
+raveled vector per dtype produces bit-identical updates in a handful
+of large fused kernels instead of a mosaic of small ones.
+
+Scope limits, by design:
+- NOT for transforms that mix information across a leaf's shape or
+  across leaves non-pointwise: per-leaf norms (clip_by_block_rms),
+  factored second moments (adafactor), or shape-aware scaling. Global
+  transforms over the whole tree (global_norm clipping) are fine —
+  the concatenation preserves the global norm (padding is zeros).
+- The optimizer state layout changes (flat vectors keyed by dtype), so
+  checkpoints are not interchangeable with the unwrapped optimizer;
+  choose per run.
+
+The flat vector is zero-padded to `pad_to` so `infer_fsdp_spec` can
+shard it over any fsdp axis size (padded tail gradients are zero, so
+the padding stays zero under any elementwise update with zero
+gradient... except weight-decay-style transforms, which decay zeros to
+zeros — still zero).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..typing import PyTree
+
+
+def _dtype_groups(leaves):
+    """Deterministic grouping: leaf indices per dtype name."""
+    groups = {}
+    for i, leaf in enumerate(leaves):
+        groups.setdefault(jnp.dtype(leaf.dtype).name, []).append(i)
+    return dict(sorted(groups.items()))
+
+
+def _flatten(tree: PyTree, pad_to: int):
+    leaves = jax.tree_util.tree_leaves(tree)
+    flats = {}
+    for name, idxs in _dtype_groups(leaves).items():
+        vec = jnp.concatenate([leaves[i].ravel() for i in idxs])
+        pad = (-vec.size) % pad_to
+        if pad:
+            vec = jnp.pad(vec, (0, pad))
+        flats[name] = vec
+    return flats
+
+
+def _unflatten(template: PyTree, flats: dict) -> PyTree:
+    leaves = jax.tree_util.tree_leaves(template)
+    treedef = jax.tree_util.tree_structure(template)
+    out = [None] * len(leaves)
+    for name, idxs in _dtype_groups(leaves).items():
+        vec = flats[name]
+        pos = 0
+        for i in idxs:
+            n = leaves[i].size
+            out[i] = jax.lax.dynamic_slice_in_dim(
+                vec, pos, n).reshape(leaves[i].shape)
+            pos += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class FlatOptState(NamedTuple):
+    inner: optax.OptState
+
+
+def flat_optimizer(inner: optax.GradientTransformation,
+                   pad_to: int = 1024) -> optax.GradientTransformation:
+    """Wrap an ELEMENTWISE optax transform to update one raveled vector
+    per dtype — same math, far fewer kernels (see module docstring)."""
+
+    def init(params):
+        return FlatOptState(inner.init(_flatten(params, pad_to)))
+
+    def update(updates, state, params=None):
+        flat_u = _flatten(updates, pad_to)
+        flat_p = None if params is None else _flatten(params, pad_to)
+        new_flat_u, inner_state = inner.update(flat_u, state.inner, flat_p)
+        return (_unflatten(updates, new_flat_u),
+                FlatOptState(inner_state))
+
+    return optax.GradientTransformation(init, update)

@@ -71,6 +71,10 @@ def parse_args(argv=None):
     p.add_argument("--warmup_steps", type=int, default=10000)
     p.add_argument("--total_steps", type=int, default=100000)
     p.add_argument("--grad_clip", type=float, default=1.0)
+    p.add_argument("--flat_optimizer", action="store_true",
+                   help="run the optimizer over one raveled vector per "
+                        "dtype (fused updates; elementwise optimizers "
+                        "only — not lamb)")
     p.add_argument("--grad_accum", type=int, default=1,
                    help=">1 accumulates gradients over k micro-batches "
                         "per optimizer update (optax.MultiSteps)")
@@ -290,6 +294,23 @@ def main(argv=None):
     opt = {"adam": optax.adam, "adamw": optax.adamw,
            "lamb": optax.lamb}[args.optimizer]
     tx = optax.chain(optax.clip_by_global_norm(args.grad_clip), opt(lr))
+    if args.flat_optimizer:
+        # whitelist, not blacklist: a future optimizer added to `opt`
+        # (lamb's trust ratio, adafactor's factored moments) silently
+        # computes the WRONG thing over a concatenated vector
+        elementwise_safe = {"adam", "adamw"}
+        if args.optimizer not in elementwise_safe:
+            raise SystemExit(
+                f"--flat_optimizer is elementwise-only "
+                f"({sorted(elementwise_safe)}); {args.optimizer!r} mixes "
+                "information across a leaf's shape, which changes "
+                "meaning under concatenation")
+        from flaxdiff_tpu.trainer.optim import flat_optimizer
+        # fuses the optax transform's per-leaf kernels into one update
+        # per dtype (part of the r3 trace's ~330-kernel / 10 ms budget;
+        # EMA and apply_updates remain leaf-wise — see trainer/optim.py).
+        # Changes the optimizer-state checkpoint layout, so pick per run.
+        tx = flat_optimizer(tx)
     if accum > 1:
         # micro-batch accumulation: k steps of summed grads per optimizer
         # update — effective batch k * batch_size without the memory.
