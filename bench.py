@@ -81,7 +81,8 @@ def _apply_jax_platforms():
 
 
 def build_trainer(tpu_native: bool, image_size: int = IMAGE_SIZE,
-                  attn_backend: str | None = None):
+                  attn_backend: str | None = None,
+                  flat_opt: bool = False):
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -90,7 +91,8 @@ def build_trainer(tpu_native: bool, image_size: int = IMAGE_SIZE,
     from flaxdiff_tpu.parallel import create_mesh
     from flaxdiff_tpu.predictors import EpsilonPredictionTransform
     from flaxdiff_tpu.schedulers import CosineNoiseSchedule
-    from flaxdiff_tpu.trainer import DiffusionTrainer, TrainerConfig
+    from flaxdiff_tpu.trainer import (DiffusionTrainer, TrainerConfig,
+                                      flat_optimizer)
 
     backend = attn_backend or ("auto" if tpu_native else "xla")
     attn = {
@@ -123,7 +125,8 @@ def build_trainer(tpu_native: bool, image_size: int = IMAGE_SIZE,
     null_cond = {"text": np.zeros((1, TEXT_LEN, TEXT_DIM), np.float32)}
     return DiffusionTrainer(
         apply_fn=apply_fn, init_fn=init_fn,
-        tx=optax.adamw(1e-4),
+        tx=(flat_optimizer(optax.adamw(1e-4)) if flat_opt
+            else optax.adamw(1e-4)),
         schedule=CosineNoiseSchedule(timesteps=1000),
         transform=EpsilonPredictionTransform(),
         mesh=mesh,
@@ -530,6 +533,21 @@ def stage_ablate(args) -> dict:
                     "error": f"{type(e).__name__}: {e}"[:160]}
             log(f"ablate {key}: {res['configs'][key]}")
     os.environ.pop("FLAXDIFF_FUSED_NORM", None)
+    # fifth config: default kernels + the flat-parameter optimizer
+    # (trainer/optim.py) — measures the r3 trace's ~10 ms leaf-wise
+    # optimizer-update claim in-context
+    try:
+        trainer = build_trainer(tpu_native=True, flat_opt=True)
+        ips, step_time, _ = run(trainer, make_batches(batch), batch,
+                                sync_every_step=False, timed_steps=timed)
+        res["configs"]["attn=flash,norm=pallas,opt=flat"] = {
+            "imgs_per_sec_per_chip": round(ips, 3),
+            "step_time_ms": round(step_time * 1e3, 2)}
+        del trainer
+    except Exception as e:
+        res["configs"]["attn=flash,norm=pallas,opt=flat"] = {
+            "error": f"{type(e).__name__}: {e}"[:160]}
+    log(f"ablate opt=flat: {res['configs']['attn=flash,norm=pallas,opt=flat']}")
     ok = {kk: vv for kk, vv in res["configs"].items()
           if "imgs_per_sec_per_chip" in vv}
     if ok:
