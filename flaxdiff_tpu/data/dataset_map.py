@@ -100,6 +100,63 @@ def _packed_shards(pattern: str = None, root: str = None,
         media_type="image")
 
 
+# The reference's production table names concrete GCS corpus combos
+# (reference dataset_map.py:51-105: combined_msml612 = laion2b-aesthetic
+# 569 shards/550 GiB + cc12m + mscoco + coyo-1m, 20M+ samples, fuse-
+# mounted). This is the same shape over packed-record shards: each part
+# is a shard directory under one mount root, all shards fused into ONE
+# global index so grain's ShardByJaxProcess slices the full mix — not
+# one corpus — per process.
+COMBINED_AESTHETIC_PARTS = (
+    "laion_aesthetics_12m",   # img2dataset of LAION-aesthetic >=6
+    "cc12m",                  # Conceptual Captions 12M
+    "mscoco",                 # MS-COCO train2017
+    "coyo_aesthetic_1m",      # COYO-700M aesthetic >=6 subset
+)
+
+
+@register_dataset("combined_aesthetic")
+def _combined_aesthetic(root: str = "/mnt/gcs_mount/flaxdiff-datasets",
+                        image_size: int = 256, parts=None,
+                        filesystem=None, max_open: int = 64,
+                        **kwargs) -> MediaDataset:
+    """Worked production entry: text-image pretraining mix at the
+    reference's documented scale (see COMBINED_AESTHETIC_PARTS above).
+
+    Produce the shards with the documented walkthrough
+    (docs/DATASETS.md): download_corpus.sh (img2dataset -> webdataset
+    tars) -> pack_dataset.py (packed-record shards, verbatim image
+    bytes) -> mount_gcs.sh or local disk -> this entry. Every named
+    part must resolve to at least one shard — a missing corpus
+    silently shrinking the training mix is the classic failure this
+    guard exists for (pass parts=[...] to train on a subset
+    deliberately)."""
+    from .sharded_source import LocalFileSystem, ShardedPackedRecordSource
+    parts = (COMBINED_AESTHETIC_PARTS if parts is None else tuple(parts))
+    if not parts:
+        raise ValueError("combined_aesthetic: parts=[] would silently "
+                         "train on nothing; pass None for the full mix")
+    fs = filesystem or LocalFileSystem()
+    shards, missing = [], []
+    for part in parts:
+        got = fs.glob(f"{root}/{part}/*.pack")
+        shards += got
+        if not got:
+            missing.append(part)
+    if missing:
+        raise FileNotFoundError(
+            f"combined_aesthetic: no *.pack shards under {root}/ for "
+            f"parts {missing}; pack each corpus first "
+            "(scripts/pack_dataset.py, see docs/DATASETS.md) or pass "
+            "parts=[...] to train on a deliberate subset")
+    return MediaDataset(
+        source=ShardedPackedRecordSource(shards=shards,
+                                         filesystem=filesystem,
+                                         max_open=max_open),
+        augmenter=ImageAugmenter(image_size=image_size),
+        media_type="image")
+
+
 @register_dataset("voxceleb2_local")
 def _voxceleb2(root: str, image_size: int = 64, num_frames: int = 16,
                with_mel: bool = True, with_face_mask: bool = True,

@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""North-star train sweep: text-conditional UNet at 256x256 (and any
+other size) with PER-BATCH outcome recording and a remat retry pass.
+
+VERDICT r3 next #3 (the 256^2 flagship has never been train-benched on
+chip; reference README.md:262-276 documents feature_depths
+[128,256,512,1024] at image 128 as its largest run — BASELINE.json's
+north star moves that shape to 256^2 at >=40% MFU) and #4 (the r3 sweep
+recorded only the winner; per-batch failures vanished into a log line,
+so batch-16-wins was unexplained). Every attempted batch lands in the
+JSON with a number or its failure cause; batches that fail get retried
+with remat=True (the knob exists on every block family but had never
+been exercised by a bench).
+
+Usage (on a healthy TPU window):
+  python scripts/bench_sweep256.py --image_size 256 \
+      --depths 128,256,512,1024 --batches 1,2,4,8,16,32 \
+      --out r4_sweep256.jsonl
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TEXT_LEN = 77
+TEXT_DIM = 768
+WARMUP_STEPS = 2
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_trainer(image_size: int, depths, remat: bool,
+                  attn_levels: int = 2, attn_backend: str = "auto"):
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from flaxdiff_tpu.models.unet import Unet
+    from flaxdiff_tpu.parallel import create_mesh
+    from flaxdiff_tpu.predictors import EpsilonPredictionTransform
+    from flaxdiff_tpu.schedulers import CosineNoiseSchedule
+    from flaxdiff_tpu.trainer import DiffusionTrainer, TrainerConfig
+
+    attn = {"heads": 8, "dim_head": 64, "backend": attn_backend,
+            "force_fp32_for_softmax": True}
+    # attention on the deepest `attn_levels` levels, as the flagship
+    configs = tuple(None if i < len(depths) - attn_levels else dict(attn)
+                    for i in range(len(depths)))
+    model = Unet(output_channels=3, emb_features=max(depths),
+                 feature_depths=tuple(depths),
+                 attention_configs=configs,
+                 num_res_blocks=2, dtype=jnp.bfloat16, remat=remat)
+    shape = (1, image_size, image_size, 3)
+    ctx = (1, TEXT_LEN, TEXT_DIM)
+
+    def apply_fn(params, x, t, cond):
+        text = cond["text"] if cond is not None else jnp.zeros(
+            (x.shape[0], TEXT_LEN, TEXT_DIM), x.dtype)
+        return model.apply({"params": params}, x, t, text)
+
+    def init_fn(key):
+        return model.init(key, jnp.zeros(shape), jnp.zeros((1,)),
+                          jnp.zeros(ctx))["params"]
+
+    mesh = create_mesh(axes={"data": -1})
+    return DiffusionTrainer(
+        apply_fn=apply_fn, init_fn=init_fn, tx=optax.adamw(1e-4),
+        schedule=CosineNoiseSchedule(timesteps=1000),
+        transform=EpsilonPredictionTransform(), mesh=mesh,
+        config=TrainerConfig(uncond_prob=0.12, normalize=False),
+        null_cond={"text": np.zeros((1, TEXT_LEN, TEXT_DIM), np.float32)})
+
+
+def make_batches(batch, image_size, n=2, seed=0):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    return [{
+        "sample": rng.normal(
+            size=(batch, image_size, image_size, 3)).astype(np.float32),
+        "cond": {"text": rng.normal(
+            size=(batch, TEXT_LEN, TEXT_DIM)).astype(np.float32)},
+    } for _ in range(n)]
+
+
+def timed_run(trainer, batch, image_size, timed_steps):
+    """(imgs/s/chip, step_ms, flops_hw). Scalar-readback sync (bench.py
+    run(): block_until_ready lies on this tunneled backend)."""
+    import jax
+    n_chips = jax.local_device_count()
+    put = [trainer.put_batch(b) for b in make_batches(batch, image_size)]
+    for i in range(WARMUP_STEPS):
+        loss = trainer.train_step(put[i % len(put)])
+    float(jax.device_get(loss))
+    flops = trainer.step_flops(put[0])
+    t0 = time.perf_counter()
+    for i in range(timed_steps):
+        loss = trainer.train_step(put[i % len(put)])
+    float(jax.device_get(loss))
+    dt = time.perf_counter() - t0
+    return batch * timed_steps / dt / n_chips, dt / timed_steps * 1e3, flops
+
+
+def attempt(image_size, depths, batch, remat, timed_steps, attn_backend):
+    """One (batch, remat) cell; returns a dict with numbers or a cause."""
+    import jax
+
+    from flaxdiff_tpu.profiling import device_peak_flops, mfu
+    try:
+        trainer = build_trainer(image_size, depths, remat,
+                                attn_backend=attn_backend)
+        ips, step_ms, flops = timed_run(trainer, batch, image_size,
+                                        timed_steps)
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"[:240], "remat": remat}
+    finally:
+        # free param+opt state before the next cell shrinks the frontier
+        try:
+            del trainer
+        except UnboundLocalError:
+            pass
+    peak = device_peak_flops()
+    return {"imgs_per_sec_per_chip": round(ips, 3),
+            "step_time_ms": round(step_ms, 2),
+            "mfu_hw": (round(mfu(flops, step_ms / 1e3, peak), 4)
+                       if flops and peak else None),
+            "remat": remat}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--image_size", type=int, default=256)
+    ap.add_argument("--depths", default="128,256,512,1024")
+    ap.add_argument("--batches", default="1,2,4,8,16,32")
+    ap.add_argument("--timed_steps", type=int, default=10)
+    ap.add_argument("--attn_backend", default="auto")
+    ap.add_argument("--trace", default="")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args(argv)
+
+    from flaxdiff_tpu.utils import apply_jax_platforms_env
+    apply_jax_platforms_env()
+    import jax
+
+    depths = tuple(int(x) for x in args.depths.split(","))
+    batches = [int(x) for x in args.batches.split(",")]
+    platform = jax.devices()[0].platform
+    res = {"metric": f"sweep{args.image_size}", "platform": platform,
+           "image_size": args.image_size, "depths": list(depths),
+           "attn_backend": args.attn_backend, "per_batch": {}}
+
+    failures = 0
+    for batch in batches:
+        cell = attempt(args.image_size, depths, batch, False,
+                       args.timed_steps, args.attn_backend)
+        res["per_batch"][str(batch)] = cell
+        log(f"batch {batch}: {cell}")
+        if "error" in cell:
+            # the remat retry answers "was that OOM?" empirically:
+            # remat trades FLOPs for activation memory, so a batch that
+            # only fits rematerialized pins the cause on memory
+            cell_r = attempt(args.image_size, depths, batch, True,
+                             args.timed_steps, args.attn_backend)
+            res["per_batch"][f"{batch}_remat"] = cell_r
+            log(f"batch {batch} remat: {cell_r}")
+            failures += 1
+            if failures >= 2 and "error" in cell_r:
+                break
+    ok = {int(k): v for k, v in res["per_batch"].items()
+          if "error" not in v and "_" not in k}
+    ok_all = {k: v for k, v in res["per_batch"].items() if "error" not in v}
+    if ok_all:
+        best_key = max(ok_all, key=lambda k:
+                       ok_all[k]["imgs_per_sec_per_chip"])
+        res["best"] = dict(ok_all[best_key], batch=best_key)
+    if args.trace and ok:
+        best_b = max(ok, key=lambda k: ok[k]["imgs_per_sec_per_chip"])
+        from flaxdiff_tpu.profiling import trace
+        trainer = build_trainer(args.image_size, depths, False,
+                                attn_backend=args.attn_backend)
+        put = [trainer.put_batch(b)
+               for b in make_batches(best_b, args.image_size)]
+        for i in range(2):
+            loss = trainer.train_step(put[i % 2])
+        float(jax.device_get(loss))
+        with trace(args.trace):
+            for i in range(5):
+                loss = trainer.train_step(put[i % 2])
+            float(jax.device_get(loss))
+        res["trace_dir"] = args.trace
+    line = json.dumps(res)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

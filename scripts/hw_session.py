@@ -58,8 +58,10 @@ def main():
                     if isinstance(tail, bytes) else tail)[-1500:]
             emit({"stage": name, "status": f"timeout {timeout}s",
                   "stderr_tail": tail})
-            # a killed client wedges the tunnel ~10-20 min; wait it out
-            time.sleep(300)
+            # a killed client wedges the tunnel for ~10-20 min (bench.py
+            # probe_backend rationale); a 5-min nap would cascade the
+            # wedge through every later stage
+            time.sleep(900)
             continue
         secs = round(time.monotonic() - t0, 1)
         if proc.returncode != 0:
