@@ -139,7 +139,10 @@ def _combined_aesthetic(root: str = "/mnt/gcs_mount/flaxdiff-datasets",
     fs = filesystem or LocalFileSystem()
     shards, missing = [], []
     for part in parts:
-        got = fs.glob(f"{root}/{part}/*.pack")
+        # sorted(): the FileSystem contract doesn't promise ordered
+        # glob results, and the global record index must be identical
+        # on every host or ShardByJaxProcess slices overlap
+        got = sorted(fs.glob(f"{root}/{part}/*.pack"))
         shards += got
         if not got:
             missing.append(part)

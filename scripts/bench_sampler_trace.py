@@ -34,6 +34,8 @@ def main(argv=None):
     ap.add_argument("--steps", type=int, default=50)
     ap.add_argument("--batch", type=int, default=1)
     ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--depths", default="64,128,256,512")
+    ap.add_argument("--emb", type=int, default=512)
     ap.add_argument("--trace", default="ddim_trace")
     ap.add_argument("--out", default="")
     args = ap.parse_args(argv)
@@ -51,10 +53,13 @@ def main(argv=None):
     from flaxdiff_tpu.utils import RngSeq
 
     size = args.image_size
+    depths = tuple(int(x) for x in args.depths.split(","))
     attn = {"heads": 8, "dim_head": 64, "backend": "auto"}
-    model = Unet(output_channels=3, emb_features=512,
-                 feature_depths=(64, 128, 256, 512),
-                 attention_configs=(None, None, dict(attn), dict(attn)),
+    model = Unet(output_channels=3, emb_features=args.emb,
+                 feature_depths=depths,
+                 attention_configs=tuple(
+                     None if i < len(depths) - 2 else dict(attn)
+                     for i in range(len(depths))),
                  num_res_blocks=2, dtype=jnp.bfloat16)
 
     def apply_fn(params, x, t, cond):
@@ -114,7 +119,8 @@ def main(argv=None):
         res["trace_dir"] = args.trace
         from scripts.analyze_trace import main as analyze
         analyze([args.trace, "--top", "12"])
-    except Exception as e:
+    # SystemExit included: analyze_trace exits on host-only captures (CPU)
+    except (Exception, SystemExit) as e:
         res["trace_error"] = f"{type(e).__name__}: {e}"[:200]
 
     line = json.dumps(res)

@@ -1,0 +1,39 @@
+"""CPU smoke tests for the round-4 hardware bench scripts.
+
+These scripts exist to run on a healthy TPU window
+(scripts/bench_sweep256.py: VERDICT r3 next #3/#4;
+scripts/bench_sampler_trace.py: #7) — CI proves the harnesses execute
+end to end and emit the JSON shape the evidence pipeline expects.
+"""
+import json
+
+import numpy as np
+
+
+def test_sweep256_records_every_batch(tmp_path, capsys):
+    from scripts.bench_sweep256 import main
+    out = tmp_path / "sweep.jsonl"
+    assert main(["--image_size", "16", "--depths", "8,16",
+                 "--batches", "8,16", "--timed_steps", "2",
+                 "--attn_backend", "xla", "--out", str(out)]) == 0
+    rec = json.loads(out.read_text().strip().splitlines()[-1])
+    assert rec["platform"] == "cpu"
+    # VERDICT r3 next #4's done-criterion shape: every attempted batch
+    # present with a number or a cause
+    for b in ("8", "16"):
+        cell = rec["per_batch"][b]
+        assert ("imgs_per_sec_per_chip" in cell) or ("error" in cell)
+    assert "best" in rec and np.isfinite(
+        rec["best"]["imgs_per_sec_per_chip"])
+
+
+def test_sampler_trace_harness(tmp_path):
+    from scripts.bench_sampler_trace import main
+    out = tmp_path / "ddim.jsonl"
+    assert main(["--image_size", "16", "--steps", "2", "--repeats", "1",
+                 "--depths", "8,16", "--emb", "16",
+                 "--trace", str(tmp_path / "tr"), "--out", str(out)]) == 0
+    rec = json.loads(out.read_text().strip().splitlines()[-1])
+    assert "uncond" in rec["configs"] and "cfg3" in rec["configs"]
+    for cfg in rec["configs"].values():
+        assert np.isfinite(cfg["latency_ms"])
