@@ -1,16 +1,12 @@
 #!/usr/bin/env python
-"""North-star train sweep: text-conditional UNet at 256x256 (and any
-other size) with PER-BATCH outcome recording and a remat retry pass.
+"""Flexible-resolution train sweep CLI over bench.py's builders.
 
-VERDICT r3 next #3 (the 256^2 flagship has never been train-benched on
-chip; reference README.md:262-276 documents feature_depths
-[128,256,512,1024] at image 128 as its largest run — BASELINE.json's
-north star moves that shape to 256^2 at >=40% MFU) and #4 (the r3 sweep
-recorded only the winner; per-batch failures vanished into a log line,
-so batch-16-wins was unexplained). Every attempted batch lands in the
-JSON with a number or its failure cause; batches that fail get retried
-with remat=True (the knob exists on every block family but had never
-been exercised by a bench).
+`python bench.py --stage sweep256` runs the canonical north-star stage
+(256^2, feature_depths 128-1024, fixed batch ladder). This CLI is the
+free-form variant for hardware sessions: any size/depths/batch list,
+same per-batch outcome recording and remat retry (VERDICT r3 next
+#3/#4), same trainer construction and scalar-readback timing — imported
+from bench.py, not duplicated.
 
 Usage (on a healthy TPU window):
   python scripts/bench_sweep256.py --image_size 256 \
@@ -27,98 +23,32 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-TEXT_LEN = 77
-TEXT_DIM = 768
-WARMUP_STEPS = 2
-
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def build_trainer(image_size: int, depths, remat: bool,
-                  attn_levels: int = 2, attn_backend: str = "auto"):
-    import jax.numpy as jnp
-    import numpy as np
-    import optax
-
-    from flaxdiff_tpu.models.unet import Unet
-    from flaxdiff_tpu.parallel import create_mesh
-    from flaxdiff_tpu.predictors import EpsilonPredictionTransform
-    from flaxdiff_tpu.schedulers import CosineNoiseSchedule
-    from flaxdiff_tpu.trainer import DiffusionTrainer, TrainerConfig
-
-    attn = {"heads": 8, "dim_head": 64, "backend": attn_backend,
-            "force_fp32_for_softmax": True}
-    # attention on the deepest `attn_levels` levels, as the flagship
-    configs = tuple(None if i < len(depths) - attn_levels else dict(attn)
-                    for i in range(len(depths)))
-    model = Unet(output_channels=3, emb_features=max(depths),
-                 feature_depths=tuple(depths),
-                 attention_configs=configs,
-                 num_res_blocks=2, dtype=jnp.bfloat16, remat=remat)
-    shape = (1, image_size, image_size, 3)
-    ctx = (1, TEXT_LEN, TEXT_DIM)
-
-    def apply_fn(params, x, t, cond):
-        text = cond["text"] if cond is not None else jnp.zeros(
-            (x.shape[0], TEXT_LEN, TEXT_DIM), x.dtype)
-        return model.apply({"params": params}, x, t, text)
-
-    def init_fn(key):
-        return model.init(key, jnp.zeros(shape), jnp.zeros((1,)),
-                          jnp.zeros(ctx))["params"]
-
-    mesh = create_mesh(axes={"data": -1})
-    return DiffusionTrainer(
-        apply_fn=apply_fn, init_fn=init_fn, tx=optax.adamw(1e-4),
-        schedule=CosineNoiseSchedule(timesteps=1000),
-        transform=EpsilonPredictionTransform(), mesh=mesh,
-        config=TrainerConfig(uncond_prob=0.12, normalize=False),
-        null_cond={"text": np.zeros((1, TEXT_LEN, TEXT_DIM), np.float32)})
-
-
-def make_batches(batch, image_size, n=2, seed=0):
-    import numpy as np
-    rng = np.random.default_rng(seed)
-    return [{
-        "sample": rng.normal(
-            size=(batch, image_size, image_size, 3)).astype(np.float32),
-        "cond": {"text": rng.normal(
-            size=(batch, TEXT_LEN, TEXT_DIM)).astype(np.float32)},
-    } for _ in range(n)]
-
-
-def timed_run(trainer, batch, image_size, timed_steps):
-    """(imgs/s/chip, step_ms, flops_hw). Scalar-readback sync (bench.py
-    run(): block_until_ready lies on this tunneled backend)."""
-    import jax
-    n_chips = jax.local_device_count()
-    put = [trainer.put_batch(b) for b in make_batches(batch, image_size)]
-    for i in range(WARMUP_STEPS):
-        loss = trainer.train_step(put[i % len(put)])
-    float(jax.device_get(loss))
-    flops = trainer.step_flops(put[0])
-    t0 = time.perf_counter()
-    for i in range(timed_steps):
-        loss = trainer.train_step(put[i % len(put)])
-    float(jax.device_get(loss))
-    dt = time.perf_counter() - t0
-    return batch * timed_steps / dt / n_chips, dt / timed_steps * 1e3, flops
-
-
 def attempt(image_size, depths, batch, remat, timed_steps, attn_backend):
-    """One (batch, remat) cell; returns a dict with numbers or a cause."""
+    """One (batch, remat) cell; returns a dict with numbers or a cause
+    (plus backend_died=True when the tunnel — not the workload — was
+    the failure, so the caller can stop burning the session window)."""
     import jax
 
+    from bench import _backend_died, build_trainer, make_batches, run
     from flaxdiff_tpu.profiling import device_peak_flops, mfu
     try:
-        trainer = build_trainer(image_size, depths, remat,
+        trainer = build_trainer(tpu_native=True, image_size=image_size,
+                                depths=depths, remat=remat,
                                 attn_backend=attn_backend)
-        ips, step_ms, flops = timed_run(trainer, batch, image_size,
-                                        timed_steps)
+        ips, step_s, flops = run(trainer,
+                                 make_batches(batch, image_size, n=2),
+                                 batch, sync_every_step=False,
+                                 timed_steps=timed_steps)
     except Exception as e:
-        return {"error": f"{type(e).__name__}: {e}"[:240], "remat": remat}
+        cell = {"error": f"{type(e).__name__}: {e}"[:300], "remat": remat}
+        if _backend_died(e):
+            cell["backend_died"] = True
+        return cell
     finally:
         # free param+opt state before the next cell shrinks the frontier
         try:
@@ -127,8 +57,8 @@ def attempt(image_size, depths, batch, remat, timed_steps, attn_backend):
             pass
     peak = device_peak_flops()
     return {"imgs_per_sec_per_chip": round(ips, 3),
-            "step_time_ms": round(step_ms, 2),
-            "mfu_hw": (round(mfu(flops, step_ms / 1e3, peak), 4)
+            "step_time_ms": round(step_s * 1e3, 2),
+            "mfu_hw": (round(mfu(flops, step_s, peak), 4)
                        if flops and peak else None),
             "remat": remat}
 
@@ -161,31 +91,41 @@ def main(argv=None):
                        args.timed_steps, args.attn_backend)
         res["per_batch"][str(batch)] = cell
         log(f"batch {batch}: {cell}")
+        if cell.get("backend_died"):
+            res["aborted"] = "backend died; measured cells preserved"
+            break
         if "error" in cell:
-            # the remat retry answers "was that OOM?" empirically:
-            # remat trades FLOPs for activation memory, so a batch that
-            # only fits rematerialized pins the cause on memory
+            # remat answers "was that OOM?" empirically: it trades
+            # FLOPs for activation memory, so a batch that only fits
+            # rematerialized pins the cause on memory
             cell_r = attempt(args.image_size, depths, batch, True,
                              args.timed_steps, args.attn_backend)
             res["per_batch"][f"{batch}_remat"] = cell_r
             log(f"batch {batch} remat: {cell_r}")
+            if cell_r.get("backend_died"):
+                res["aborted"] = "backend died; measured cells preserved"
+                break
             failures += 1
             if failures >= 2 and "error" in cell_r:
                 break
-    ok = {int(k): v for k, v in res["per_batch"].items()
-          if "error" not in v and "_" not in k}
+    ok_num = {int(k): v for k, v in res["per_batch"].items()
+              if "error" not in v and "_" not in k}
     ok_all = {k: v for k, v in res["per_batch"].items() if "error" not in v}
     if ok_all:
         best_key = max(ok_all, key=lambda k:
                        ok_all[k]["imgs_per_sec_per_chip"])
         res["best"] = dict(ok_all[best_key], batch=best_key)
-    if args.trace and ok:
-        best_b = max(ok, key=lambda k: ok[k]["imgs_per_sec_per_chip"])
+    if args.trace and ok_num:
+        from bench import build_trainer, make_batches
         from flaxdiff_tpu.profiling import trace
-        trainer = build_trainer(args.image_size, depths, False,
+        best_b = max(ok_num,
+                     key=lambda k: ok_num[k]["imgs_per_sec_per_chip"])
+        trainer = build_trainer(tpu_native=True,
+                                image_size=args.image_size,
+                                depths=depths,
                                 attn_backend=args.attn_backend)
         put = [trainer.put_batch(b)
-               for b in make_batches(best_b, args.image_size)]
+               for b in make_batches(best_b, args.image_size, n=2)]
         for i in range(2):
             loss = trainer.train_step(put[i % 2])
         float(jax.device_get(loss))

@@ -22,18 +22,23 @@ import time
 
 OUT = sys.argv[1] if len(sys.argv) > 1 else "r4_hw_session.jsonl"
 
-# (stage, timeout_s) in information-value order: tune first so later
-# stages run with the measured winner; sweep before the micro stages so
-# a mid-session wedge still leaves the headline number.
+# (stage, timeout_s) in information-value order: headline sweep first
+# so a mid-session wedge still leaves it; tuned micros after flashtune.
 PLAN = [
-    ("flashtune", 1200),
     ("sweep", 2700),
-    ("ablate", 2400),
-    ("attnpad", 900),
     ("ref", 900),
+    ("flashtune", 1200),
     ("ddim", 1500),
+    ("attnpad", 900),
+    ("ablate", 2400),
+    ("sweep256", 2700),
     ("longseq", 1200),
 ]
+
+# stages that run under the measured flashtune-winner env (bench.py
+# TUNED_STAGES rationale: an unvalidated winner must not be able to
+# take down a headline stage)
+TUNED = ("attnpad", "ablate", "longseq")
 
 
 def emit(rec):
@@ -44,14 +49,24 @@ def emit(rec):
 
 
 def main():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import export_winner_env   # shared winner-export logic
+
     env = os.environ.copy()
+    stages_done = {}
     emit({"session_start": PLAN})
     for name, timeout in PLAN:
         t0 = time.monotonic()
         cmd = [sys.executable, "bench.py", "--stage", name]
+        stage_env = dict(env)
+        if name in TUNED:
+            added = export_winner_env(stage_env, stages_done)
+            if added:
+                emit({"stage": name, "tuned_env": added})
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True,
-                                  timeout=timeout, env=env)
+                                  timeout=timeout, env=stage_env)
         except subprocess.TimeoutExpired as e:
             tail = e.stderr or b""
             tail = (tail.decode(errors="replace")
@@ -77,15 +92,7 @@ def main():
         rec = {"stage": name, "status": "ok", "secs": secs,
                "result": out, "stderr_tail": proc.stderr[-1500:]}
         emit(rec)
-        if name == "flashtune" and out.get("best"):
-            best = out["best"]
-            env["FLAXDIFF_FLASH_BLOCK_Q"] = str(best["block_q"])
-            env["FLAXDIFF_FLASH_BLOCK_K"] = str(best["block_k"])
-            if best.get("native_d"):
-                env["FLAXDIFF_FLASH_NATIVE_D"] = "1"
-            emit({"export": best})
-        if name == "sweep" and out.get("batch_per_chip"):
-            env["FLAXDIFF_BENCH_ABLATE_BATCH"] = str(out["batch_per_chip"])
+        stages_done[name] = out
     emit({"session_end": True})
 
 
