@@ -98,6 +98,7 @@ def _apply_jax_platforms():
 def build_trainer(tpu_native: bool, image_size: int = IMAGE_SIZE,
                   attn_backend: str | None = None,
                   flat_opt: bool = False,
+                  flat_params: bool = False,
                   depths: tuple = (64, 128, 256, 512),
                   attn_levels: int = 2,
                   remat: bool = False):
@@ -151,7 +152,8 @@ def build_trainer(tpu_native: bool, image_size: int = IMAGE_SIZE,
         schedule=CosineNoiseSchedule(timesteps=1000),
         transform=EpsilonPredictionTransform(),
         mesh=mesh,
-        config=TrainerConfig(uncond_prob=0.12, normalize=False),
+        config=TrainerConfig(uncond_prob=0.12, normalize=False,
+                             flat_params=flat_params),
         null_cond=null_cond,
     )
 
@@ -311,6 +313,24 @@ def stage_sweep(args) -> dict:
                 "aborted": core["aborted"] or "every batch failed"}
     ips, batch, step_time, flops, best_remat = core["best"]
     peak = core["peak"]
+
+    if core["aborted"]:
+        # backend died mid-sweep: rebuilding for the FLOPs twin / trace
+        # would throw uncaught on the dead backend and discard the
+        # measured cells — return them as the result instead
+        from flaxdiff_tpu.profiling import mfu as _mfu
+        return {
+            "platform": jax.devices()[0].platform,
+            "image_size": image_size,
+            "imgs_per_sec_per_chip": round(ips, 3),
+            "batch_per_chip": batch,
+            "remat": best_remat,
+            "per_batch": core["per_batch"],
+            "step_time_ms": round(step_time * 1e3, 2),
+            "mfu_hw": (round(_mfu(flops, step_time, peak), 4)
+                       if flops and peak else None),
+            "aborted": core["aborted"],
+        }
 
     # Analytic model-FLOPs (best batch only): an xla-attention twin's
     # traced jaxpr exposes the attention matmuls at TRUE head_dim (a flash
@@ -671,21 +691,28 @@ def stage_ablate(args) -> dict:
                     "error": f"{type(e).__name__}: {e}"[:160]}
             log(f"ablate {key}: {res['configs'][key]}")
     os.environ.pop("FLAXDIFF_FUSED_NORM", None)
-    # fifth config: default kernels + the flat-parameter optimizer
-    # (trainer/optim.py) — measures the r3 trace's ~10 ms leaf-wise
-    # optimizer-update claim in-context
-    try:
-        trainer = build_trainer(tpu_native=True, flat_opt=True)
-        ips, step_time, _ = run(trainer, make_batches(batch), batch,
-                                sync_every_step=False, timed_steps=timed)
-        res["configs"]["attn=flash,norm=pallas,opt=flat"] = {
-            "imgs_per_sec_per_chip": round(ips, 3),
-            "step_time_ms": round(step_time * 1e3, 2)}
-        del trainer
-    except Exception as e:
-        res["configs"]["attn=flash,norm=pallas,opt=flat"] = {
-            "error": f"{type(e).__name__}: {e}"[:160]}
-    log(f"ablate opt=flat: {res['configs']['attn=flash,norm=pallas,opt=flat']}")
+    # optimizer-path configs at default kernels: flat_opt fuses only the
+    # optax transform (EMA + apply_updates stay leaf-wise); flat_params
+    # flattens the WHOLE state so optimizer+EMA+apply are per-dtype
+    # fused and grads arrive flat (the r3 trace's ~10 ms / 327-kernel
+    # leaf-wise-update budget, measured in-context)
+    for key, kwargs in (("attn=flash,norm=pallas,opt=flat",
+                         dict(flat_opt=True)),
+                        ("attn=flash,norm=pallas,opt=flatparams",
+                         dict(flat_params=True))):
+        try:
+            trainer = build_trainer(tpu_native=True, **kwargs)
+            ips, step_time, _ = run(trainer, make_batches(batch), batch,
+                                    sync_every_step=False,
+                                    timed_steps=timed)
+            res["configs"][key] = {
+                "imgs_per_sec_per_chip": round(ips, 3),
+                "step_time_ms": round(step_time * 1e3, 2)}
+            del trainer
+        except Exception as e:
+            res["configs"][key] = {
+                "error": f"{type(e).__name__}: {e}"[:160]}
+        log(f"ablate {key}: {res['configs'][key]}")
     ok = {kk: vv for kk, vv in res["configs"].items()
           if "imgs_per_sec_per_chip" in vv}
     if ok:
@@ -884,6 +911,11 @@ def run_stage(name: str, args, env, timeout_s: int, retries: int,
             time.sleep(back)
         t0 = time.monotonic()
         killed_prev = False
+        # re-clamp every attempt: a retry must not inherit the
+        # stage-start timeout and overrun the hard budget
+        attempt_timeout = timeout_s
+        if time_left is not None and time_left() != float("inf"):
+            attempt_timeout = min(timeout_s, max(int(time_left()) - 60, 30))
         try:
             # Popen (not subprocess.run) so the SIGTERM handler can kill
             # the in-flight child: an orphaned stage keeps the tunnel
@@ -893,7 +925,7 @@ def run_stage(name: str, args, env, timeout_s: int, retries: int,
                                      stderr=subprocess.PIPE, text=True,
                                      env=env)
             _ACTIVE_CHILD[0] = child
-            out_txt, err_txt = child.communicate(timeout=timeout_s)
+            out_txt, err_txt = child.communicate(timeout=attempt_timeout)
             proc = subprocess.CompletedProcess(cmd, child.returncode,
                                                out_txt, err_txt)
         except subprocess.TimeoutExpired:
@@ -902,7 +934,7 @@ def run_stage(name: str, args, env, timeout_s: int, retries: int,
             # keep the child's partial stderr: it says which phase
             # (build, warmup, batch N, trace) the stage wedged in
             tail = (err_txt or "")[-300:]
-            last = f"timeout after {timeout_s}s (killed); last output: {tail}"
+            last = f"timeout after {attempt_timeout}s (killed); last output: {tail}"
             log(f"stage {name}: {last}")
             killed_prev = True
             continue
@@ -1077,21 +1109,28 @@ def main():
                 time_left=left)
         sweep = result["stages"].get("sweep", {})
         ref = result["stages"].get("ref", {})
-        if sweep.get("status") == "ok":
+        # .get() throughout: a stage can finish rc 0 with NO throughput
+        # (every batch failed / aborted-with-cells) — an unguarded key
+        # here would kill the orchestrator mid-aggregation and lose the
+        # final emit (the exact null-evidence mode this file prevents)
+        if sweep.get("status") == "ok" and \
+                sweep.get("imgs_per_sec_per_chip"):
             result["value"] = sweep["imgs_per_sec_per_chip"]
             result["mfu_hw"] = sweep.get("mfu_hw")
             result["mfu_model"] = sweep.get("mfu_model")
             result["batch_per_chip"] = sweep.get("batch_per_chip")
             result["step_time_ms"] = sweep.get("step_time_ms")
             result["trace_dir"] = sweep.get("trace_dir")
-        if ref.get("status") == "ok" and result["value"]:
+        if ref.get("status") == "ok" and result["value"] \
+                and ref.get("imgs_per_sec_per_chip"):
             result["vs_baseline"] = round(
                 result["value"] / ref["imgs_per_sec_per_chip"], 3)
         ddim = result["stages"].get("ddim", {})
-        if ddim.get("status") == "ok":
-            result[ddim["key"]] = ddim["latency_ms"]
+        if ddim.get("status") == "ok" and ddim.get("key"):
+            result[ddim["key"]] = ddim.get("latency_ms")
         s256 = result["stages"].get("sweep256", {})
-        if s256.get("status") == "ok":
+        if s256.get("status") == "ok" and \
+                s256.get("imgs_per_sec_per_chip"):
             result["sweep256_imgs_per_sec_per_chip"] = \
                 s256["imgs_per_sec_per_chip"]
             result["sweep256_mfu_hw"] = s256.get("mfu_hw")

@@ -48,6 +48,15 @@ class TrainerConfig:
     # The reference has no preemption handling (a host loss kills the
     # job, SURVEY §5.3).
     checkpoint_on_sigterm: bool = True
+    # Flat-parameter training (trainer/optim.py rationale): params, EMA
+    # and optimizer state live as ONE padded vector per dtype; the model
+    # unflattens inside the loss, AD returns flat grads, and every
+    # optimizer/EMA/apply update is a handful of fused HBM-floor kernels
+    # instead of ~2 launch-bound kernels per leaf. Requires an
+    # ELEMENTWISE optax chain (adam/adamw/sgd/lion [+ global-norm
+    # clip]; NOT lamb/adafactor/per-block transforms). Checkpoint
+    # layout changes (flat vectors) — choose per run.
+    flat_params: bool = False
     # In-training profiler capture: when set, a jax.profiler trace of
     # `profile_steps` steps starting at `profile_at_step` (post-warmup)
     # lands in profile_dir.
@@ -79,6 +88,27 @@ class DiffusionTrainer:
         self.transform = transform
         self.checkpointer = checkpointer
         self._apply_fn = apply_fn
+
+        self._param_template = None
+        if config.flat_params:
+            from .optim import param_template, unflatten_params
+            key_t = jax.random.PRNGKey(config.seed)
+            self._param_template = param_template(
+                jax.eval_shape(lambda k: init_fn(k),
+                               jax.random.split(key_t)[0]))
+            template = self._param_template
+            inner_apply, inner_init = apply_fn, init_fn
+
+            def apply_fn(flats, x, t, cond):        # noqa: F811
+                # the unflatten runs INSIDE the differentiated function:
+                # its AD transpose re-assembles leaf gradients into the
+                # flat vector, so grads arrive flat for free
+                return inner_apply(unflatten_params(template, flats),
+                                   x, t, cond)
+
+            def init_fn(key):                       # noqa: F811
+                from .optim import flatten_params
+                return flatten_params(inner_init(key), 1024)
 
         step_cfg = TrainStepConfig(
             uncond_prob=config.uncond_prob,
@@ -388,6 +418,12 @@ class DiffusionTrainer:
 
     # -- inference-side helpers ---------------------------------------------
     def get_params(self, use_ema: bool = True) -> PyTree:
-        if use_ema and self.state.ema_params is not None:
-            return self.state.ema_params
-        return self.state.params
+        params = (self.state.ema_params
+                  if use_ema and self.state.ema_params is not None
+                  else self.state.params)
+        if self._param_template is not None:
+            # flat-params mode: callers (samplers, validation, export)
+            # expect the structured tree
+            from .optim import unflatten_params
+            return unflatten_params(self._param_template, params)
+        return params

@@ -75,6 +75,12 @@ def parse_args(argv=None):
                    help="run the optimizer over one raveled vector per "
                         "dtype (fused updates; elementwise optimizers "
                         "only — not lamb)")
+    p.add_argument("--flat_params", action="store_true",
+                   help="params/EMA/opt-state live as one padded vector "
+                        "per dtype: fused optimizer+EMA+apply updates "
+                        "AND flat grads via AD (supersedes "
+                        "--flat_optimizer; elementwise optimizers only; "
+                        "changes checkpoint layout)")
     p.add_argument("--grad_accum", type=int, default=1,
                    help=">1 accumulates gradients over k micro-batches "
                         "per optimizer update (optax.MultiSteps)")
@@ -294,6 +300,18 @@ def main(argv=None):
     opt = {"adam": optax.adam, "adamw": optax.adamw,
            "lamb": optax.lamb}[args.optimizer]
     tx = optax.chain(optax.clip_by_global_norm(args.grad_clip), opt(lr))
+    if args.flat_params:
+        # the whole state lives flat (TrainerConfig.flat_params) — the
+        # inner optimizer already sees flat vectors, so flat_optimizer
+        # wrapping would be a redundant second flatten
+        elementwise_safe = {"adam", "adamw"}
+        if args.optimizer not in elementwise_safe:
+            raise SystemExit(
+                f"--flat_params is elementwise-only "
+                f"({sorted(elementwise_safe)}); {args.optimizer!r} mixes "
+                "information across a leaf's shape, which changes "
+                "meaning under concatenation")
+        args.flat_optimizer = False
     if args.flat_optimizer:
         # whitelist, not blacklist: a future optimizer added to `opt`
         # (lamb's trust ratio, adafactor's factored moments) silently
@@ -385,7 +403,8 @@ def main(argv=None):
         config=TrainerConfig(ema_decay=args.ema_decay,
                              uncond_prob=args.uncond_prob,
                              log_every=args.log_every, seed=args.seed,
-                             profile_dir=args.profile_dir),
+                             profile_dir=args.profile_dir,
+                             flat_params=args.flat_params),
         policy=policy, null_cond=null_cond, checkpointer=ckpt,
         autoencoder=autoencoder)
 
