@@ -1,0 +1,24 @@
+#!/bin/bash
+# Patient tunnel prober: one long-timeout probe every ~15 min; on the
+# first healthy answer, run the full hardware bench session and exit.
+# Rationale in bench.py probe_backend: killed-mid-init clients leak a
+# server-side lease for ~10-20 min, so sparse patient probes beat churn
+# (r3 observed a 15-min-interval prober succeeding every time while
+# 120s-retry probing failed for an hour).
+set -u
+OUT=${1:-r4_hw_session2.jsonl}
+DEADLINE=$(( $(date +%s) + ${2:-14400} ))   # default: give up after 4 h
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if timeout 560 python - <<'EOF'
+import jax, sys
+sys.exit(0 if jax.devices()[0].platform == "tpu" else 1)
+EOF
+  then
+    echo "$(date -u +%FT%TZ) tunnel healthy; starting session" >&2
+    exec python scripts/hw_session.py "$OUT"
+  fi
+  echo "$(date -u +%FT%TZ) tunnel still wedged; sleeping 900s" >&2
+  sleep 900
+done
+echo "$(date -u +%FT%TZ) gave up waiting for the tunnel" >&2
