@@ -696,11 +696,18 @@ def stage_ablate(args) -> dict:
     # flattens the WHOLE state so optimizer+EMA+apply are per-dtype
     # fused and grads arrive flat (the r3 trace's ~10 ms / 327-kernel
     # leaf-wise-update budget, measured in-context)
-    for key, kwargs in (("attn=flash,norm=pallas,opt=flat",
-                         dict(flat_opt=True)),
-                        ("attn=flash,norm=pallas,opt=flatparams",
-                         dict(flat_params=True))):
+    for key, kwargs, env_add in (
+            ("attn=flash,norm=pallas,opt=flat", dict(flat_opt=True), {}),
+            ("attn=flash,norm=pallas,opt=flatparams",
+             dict(flat_params=True), {}),
+            # BHLD layout: head permutation folded into the projections,
+            # free reshapes into the kernel's native [B*H,L,D] grid —
+            # measures the r3 trace's ~750 layout-copy claim in-context
+            ("attn=flash,norm=pallas,layout=bhld", {},
+             {"FLAXDIFF_ATTN_BHLD": "1"})):
         try:
+            for ek, ev in env_add.items():
+                os.environ[ek] = ev
             trainer = build_trainer(tpu_native=True, **kwargs)
             ips, step_time, _ = run(trainer, make_batches(batch), batch,
                                     sync_every_step=False,
@@ -712,6 +719,9 @@ def stage_ablate(args) -> dict:
         except Exception as e:
             res["configs"][key] = {
                 "error": f"{type(e).__name__}: {e}"[:160]}
+        finally:
+            for ek in env_add:
+                os.environ.pop(ek, None)
         log(f"ablate {key}: {res['configs'][key]}")
     ok = {kk: vv for kk, vv in res["configs"].items()
           if "imgs_per_sec_per_chip" in vv}

@@ -8,21 +8,104 @@ first-party Pallas kernel in ops/flash_attention.py.
 """
 from __future__ import annotations
 
+import os
 from typing import Callable, Optional
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import dot_product_attention
+from ..ops.attention import dot_product_attention, dot_product_attention_bhld
 from ..typing import Dtype
 from .common import kernel_init
+
+
+class _ProjToHeads(nn.Module):
+    """[B, L, C] -> [B, H, L, D] projection whose params are
+    shape/name-identical to `nn.DenseGeneral((H, D))` (kernel (C,H,D),
+    bias (H,D)) — checkpoints swap freely between layouts. The output
+    permutation is folded into the projection dot_general itself, so no
+    separate transpose op ever exists for XLA to materialize."""
+
+    heads: int
+    dim_head: int
+    use_bias: bool = True
+    dtype: Optional[Dtype] = None
+    precision: Optional[jax.lax.Precision] = None
+    kernel_init: Callable = kernel_init(1.0)
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c = x.shape[-1]
+        # init on the FLATTENED (C, H*D) shape exactly as
+        # nn.DenseGeneral((H, D)) does (its kernel_init_wrap): a
+        # variance-scaling init drawn directly on (C, H, D) would see
+        # fan_in=H*C / fan_out=D*C and start ~sqrt(H)x narrower than
+        # the layout-independent checkpoint contract promises
+        kernel = self.param(
+            "kernel",
+            lambda key, shape, dtype=jnp.float32: self.kernel_init(
+                key, (c, self.heads * self.dim_head), dtype
+            ).reshape(shape),
+            (c, self.heads, self.dim_head))
+        bias = (self.param("bias", nn.initializers.zeros,
+                           (self.heads, self.dim_head))
+                if self.use_bias else None)
+        x, kernel, bias = nn.dtypes.promote_dtype(
+            x, kernel, bias, dtype=self.dtype)
+        y = jnp.einsum("blc,chd->bhld", x, kernel,
+                       precision=self.precision)
+        if bias is not None:
+            y = y + bias[None, :, None, :]
+        return y
+
+
+class _ProjFromHeads(nn.Module):
+    """[B, H, L, D] -> [B, L, C]; params identical to
+    `nn.DenseGeneral(C, axis=(-2, -1))` on a [B, L, H, D] input
+    (kernel (H,D,C), bias (C,))."""
+
+    features: int
+    heads: int
+    dim_head: int
+    use_bias: bool = True
+    dtype: Optional[Dtype] = None
+    precision: Optional[jax.lax.Precision] = None
+    kernel_init: Callable = kernel_init(1.0)
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        # flattened-shape init matching nn.DenseGeneral(C, axis=(-2,-1))
+        # (see _ProjToHeads)
+        kernel = self.param(
+            "kernel",
+            lambda key, shape, dtype=jnp.float32: self.kernel_init(
+                key, (self.heads * self.dim_head, self.features), dtype
+            ).reshape(shape),
+            (self.heads, self.dim_head, self.features))
+        bias = (self.param("bias", nn.initializers.zeros,
+                           (self.features,))
+                if self.use_bias else None)
+        x, kernel, bias = nn.dtypes.promote_dtype(
+            x, kernel, bias, dtype=self.dtype)
+        y = jnp.einsum("bhld,hdc->blc", x, kernel,
+                       precision=self.precision)
+        if bias is not None:
+            y = y + bias
+        return y
 
 
 class AttentionLayer(nn.Module):
     """Multi-head self/cross attention over [B, L, C] (+[B,H,W,C] auto-flatten).
 
     backend: "auto" | "flash" | "xla".
+    bhld: project q/k/v straight into the flash kernel's native
+    [B, H, L, D] layout — the head permutation is folded into the
+    projection matmuls, so the per-operand transposes (and XLA's
+    materialized copies around the pallas custom call — ~750 copy
+    ops/step in the r3 trace) disappear. None (default) reads
+    FLAXDIFF_ATTN_BHLD so the bench can A/B without a model rebuild.
+    Parameters are layout-independent (same names and shapes).
     """
 
     heads: int = 4
@@ -32,6 +115,7 @@ class AttentionLayer(nn.Module):
     precision: Optional[jax.lax.Precision] = None
     use_bias: bool = True
     force_fp32_for_softmax: bool = True
+    bhld: Optional[bool] = None
     kernel_init: Callable = kernel_init(1.0)
 
     @nn.compact
@@ -41,7 +125,28 @@ class AttentionLayer(nn.Module):
             b, h, w, c = x.shape
             x = x.reshape(b, h * w, c)
         context = x if context is None else context
-        inner = self.heads * self.dim_head
+        bhld = (self.bhld if self.bhld is not None
+                else os.environ.get("FLAXDIFF_ATTN_BHLD") == "1")
+        if bhld:
+            proj = lambda name: _ProjToHeads(
+                heads=self.heads, dim_head=self.dim_head,
+                use_bias=self.use_bias, dtype=self.dtype,
+                precision=self.precision, kernel_init=self.kernel_init,
+                name=name)
+            q = proj("to_q")(x)
+            k = proj("to_k")(context)
+            v = proj("to_v")(context)
+            out = dot_product_attention_bhld(
+                q, k, v, backend=self.backend,
+                force_fp32_for_softmax=self.force_fp32_for_softmax)
+            out = _ProjFromHeads(
+                features=x.shape[-1], heads=self.heads,
+                dim_head=self.dim_head, use_bias=self.use_bias,
+                dtype=self.dtype, precision=self.precision,
+                kernel_init=self.kernel_init, name="to_out")(out)
+            if spatial:
+                out = out.reshape(b, h, w, c)
+            return out
         dense = lambda name: nn.DenseGeneral(
             (self.heads, self.dim_head), use_bias=self.use_bias,
             dtype=self.dtype, precision=self.precision,

@@ -181,22 +181,7 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 return _xla_attention(
                     q, k, v, scale=scale,
                     force_fp32_for_softmax=force_fp32_for_softmax)
-        pad = (-d) % 128
-        if pad and d % 8 == 0:
-            import os
-            if os.environ.get("FLAXDIFF_FLASH_NATIVE_D") == "1":
-                # Experimental: hand the kernel the true head_dim and let
-                # Mosaic mask the sub-128 lanes in-register — skips the HBM
-                # traffic and copies of materialized zero padding. Gated
-                # off by default until measured on hardware (bench stage
-                # "attnpad" quantifies it; VERDICT r2 weak #2).
-                pad = 0
-        if pad:
-            # Zero-padding head_dim is exact: padded dims contribute 0 to
-            # q·k logits (scale stays 1/sqrt(d_orig)) and 0 to the padded
-            # output channels, which are sliced off.
-            widths = ((0, 0), (0, 0), (0, 0), (0, pad))
-            q, k, v = (jnp.pad(t, widths) for t in (q, k, v))
+        q, k, v, pad = _maybe_pad_head_dim(q, k, v)
         if sharded is not None:
             out = _shard_mapped_flash(q, k, v, scale_eff, mesh, *sharded)
         else:
@@ -208,3 +193,91 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       "falling back to XLA attention", stacklevel=2)
     return _xla_attention(q, k, v, scale=scale,
                           force_fp32_for_softmax=force_fp32_for_softmax)
+
+
+def _maybe_pad_head_dim(q, k, v):
+    """Zero-pad head_dim to a 128-lane multiple unless
+    FLAXDIFF_FLASH_NATIVE_D=1 lets the kernel take the true sub-128 dim
+    (Mosaic masks the unused lanes). Padding is exact: padded dims
+    contribute 0 to logits (scale stays 1/sqrt(d_orig)) and 0 to the
+    padded output channels, which the caller slices off. Returns
+    (q, k, v, pad). Shared by BOTH dispatchers so the policy cannot
+    drift between layouts."""
+    d = q.shape[-1]
+    pad = (-d) % 128
+    if pad and d % 8 == 0:
+        import os
+        if os.environ.get("FLAXDIFF_FLASH_NATIVE_D") == "1":
+            pad = 0
+    if pad:
+        widths = ((0, 0),) * (q.ndim - 1) + ((0, pad),)
+        q, k, v = (jnp.pad(t, widths) for t in (q, k, v))
+    return q, k, v, pad
+
+
+def _xla_attention_bhld(q, k, v, scale=None,
+                        force_fp32_for_softmax=True):
+    """Plain XLA attention over [B, H, L, D] operands."""
+    orig_dtype = q.dtype
+    d = q.shape[-1]
+    scale = (scale if scale is not None
+             else 1.0 / jnp.sqrt(d).astype(jnp.float32))
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if force_fp32_for_softmax:
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    else:
+        probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(orig_dtype), v)
+
+
+def dot_product_attention_bhld(q: jax.Array, k: jax.Array, v: jax.Array,
+                               backend: str = "auto",
+                               scale: Optional[float] = None,
+                               force_fp32_for_softmax: bool = True
+                               ) -> jax.Array:
+    """Attention over [B, H, L, D] operands — the flash kernel's native
+    grid layout, reached by FREE reshapes (B and H adjacent).
+
+    The [B,L,H,D] dispatcher pays a materialized transpose per operand
+    around the opaque pallas custom call (the r3 trace counted ~750
+    layout-copy ops/step around `_to_bh`); a BHLD-projecting module
+    (models/attention.py AttentionLayer bhld=True) avoids them
+    entirely. Sequence-parallel / performer / multi-device paths route
+    through the BLHD dispatcher (one transpose each way — they were
+    not the copy hotspot); single-device flash and XLA run natively."""
+    assert q.ndim == 4 and k.ndim == 4 and v.ndim == 4
+    b, h, lq, d = q.shape
+
+    from ..parallel.context import get_active_mesh
+    mesh = get_active_mesh()
+    multi = mesh is not None and mesh.devices.size > 1
+    if backend in ("ring", "ulysses", "performer") or multi:
+        out = dot_product_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), backend=backend, scale=scale,
+            force_fp32_for_softmax=force_fp32_for_softmax)
+        return out.transpose(0, 2, 1, 3)
+
+    use_flash = (backend in ("auto", "flash")
+                 and attention_backend_available("flash")
+                 and lq >= 128)
+    if not use_flash:
+        if backend == "flash" and not attention_backend_available("flash"):
+            import warnings
+            warnings.warn("backend='flash' requested but no TPU is "
+                          "available; falling back to XLA attention",
+                          stacklevel=2)
+        return _xla_attention_bhld(
+            q, k, v, scale=scale,
+            force_fp32_for_softmax=force_fp32_for_softmax)
+
+    from .flash_attention import flash_attention_bh
+    scale_eff = scale if scale is not None else 1.0 / (d ** 0.5)
+    q, k, v, pad = _maybe_pad_head_dim(q, k, v)
+    q3 = q.reshape(b * h, q.shape[2], q.shape[3])
+    k3 = k.reshape(b * h, k.shape[2], k.shape[3])
+    v3 = v.reshape(b * h, v.shape[2], v.shape[3])
+    out = flash_attention_bh(q3, k3, v3, scale=scale_eff)
+    out = out.reshape(b, h, lq, out.shape[-1])
+    return out[..., :d] if pad else out

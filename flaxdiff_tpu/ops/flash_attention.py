@@ -258,28 +258,31 @@ def _block_sizes(lq: int, lk: int, block_q: Optional[int],
     return bq, bk
 
 
-def _fwd_impl(q, k, v, scale, block_q, block_k, interpret,
+def _fwd_impl(q3, k3, v3, scale, block_q, block_k, interpret,
               save_residuals: bool = False):
-    b, lq, h, d = q.shape
-    kv_len = k.shape[1]
+    """Forward over [B*H, L, D] operands (the layout the kernel grids
+    over natively — BHLD callers reach here with FREE reshapes, BLHD
+    callers pay one transpose in _to_bh)."""
+    bh, lq, d = q3.shape
+    kv_len = k3.shape[1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     bq, bk = _block_sizes(lq, kv_len, block_q, block_k, interpret)
     lanes = _FORCE_LANES or (1 if interpret else LANES)
 
-    qb = _pad_to(_to_bh(q), 1, bq)
-    kb = _pad_to(_to_bh(k), 1, bk)
-    vb = _pad_to(_to_bh(v), 1, bk)
+    qb = _pad_to(q3, 1, bq)
+    kb = _pad_to(k3, 1, bk)
+    vb = _pad_to(v3, 1, bk)
     lq_pad, lk_pad = qb.shape[1], kb.shape[1]
 
-    grid = (b * h, lq_pad // bq, lk_pad // bk)
+    grid = (bh, lq_pad // bq, lk_pad // bk)
     out_specs = [pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0))]
-    out_shape = [jax.ShapeDtypeStruct((b * h, lq_pad, d), q.dtype)]
+    out_shape = [jax.ShapeDtypeStruct((bh, lq_pad, d), q3.dtype)]
     if save_residuals:
         out_specs.append(
             pl.BlockSpec((1, bq, lanes), lambda bh, qi, ki: (bh, qi, 0)))
         out_shape.append(
-            jax.ShapeDtypeStruct((b * h, lq_pad, lanes), jnp.float32))
+            jax.ShapeDtypeStruct((bh, lq_pad, lanes), jnp.float32))
     res = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, kv_len=kv_len,
                           block_k=bk),
@@ -304,17 +307,19 @@ def _fwd_impl(q, k, v, scale, block_q, block_k, interpret,
 
 
 
-def _bwd_impl(q, k, v, out_bh, lse, g, scale, block_q, block_k, interpret):
-    b, lq, h, d = q.shape
-    kv_len = k.shape[1]
+def _bwd_impl(q3, k3, v3, out_bh, lse, g3, scale, block_q, block_k,
+              interpret):
+    """Backward over [B*H, L, D] operands; returns 3-D dq/dk/dv."""
+    bh, lq, d = q3.shape
+    kv_len = k3.shape[1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     bq, bk = _block_sizes(lq, kv_len, block_q, block_k, interpret)
 
-    qb = _pad_to(_to_bh(q), 1, bq)
-    kb = _pad_to(_to_bh(k), 1, bk)
-    vb = _pad_to(_to_bh(v), 1, bk)
-    gb = _pad_to(_to_bh(g), 1, bq)
+    qb = _pad_to(q3, 1, bq)
+    kb = _pad_to(k3, 1, bk)
+    vb = _pad_to(v3, 1, bk)
+    gb = _pad_to(g3, 1, bq)
     ob = _pad_to(out_bh, 1, bq)
     lq_pad, lk_pad = qb.shape[1], kb.shape[1]
     lanes = lse.shape[-1]
@@ -330,10 +335,10 @@ def _bwd_impl(q, k, v, out_bh, lse, g, scale, block_q, block_k, interpret):
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, kv_len=kv_len,
                           block_k=bk),
-        grid=(b * h, lq_pad // bq, lk_pad // bk),
+        grid=(bh, lq_pad // bq, lk_pad // bk),
         in_specs=qkv_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, lq_pad, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, lq_pad, d), q3.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -352,15 +357,15 @@ def _bwd_impl(q, k, v, out_bh, lse, g, scale, block_q, block_k, interpret):
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, kv_len=kv_len,
                           block_k=bk),
-        grid=(b * h, lk_pad // bk, lq_pad // bq),
+        grid=(bh, lk_pad // bk, lq_pad // bq),
         in_specs=kv_specs,
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, lk_pad, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, lk_pad, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, lk_pad, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, lk_pad, d), v3.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -371,10 +376,7 @@ def _bwd_impl(q, k, v, out_bh, lse, g, scale, block_q, block_k, interpret):
         interpret=interpret,
     )(qb, kb, vb, gb, ob, lse)
 
-    dq = _from_bh(dq[:, :lq], b, h)
-    dk = _from_bh(dk[:, :kv_len], b, h)
-    dv = _from_bh(dv[:, :kv_len], b, h)
-    return dq, dk, dv
+    return dq[:, :lq], dk[:, :kv_len], dv[:, :kv_len]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -391,23 +393,61 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     (FLAXDIFF_FLASH_NATIVE_D=1) and zero-padded to 128 otherwise.
     Sequence dims are padded internally. block_q/block_k default to
     large sequence-capped blocks (see _block_sizes).
+
+    The [B,L,H,D] layout pays a transpose into the kernel's native
+    [B*H,L,D] grid layout on every operand — BHLD-projecting callers
+    should use flash_attention_bh, whose reshapes are free (the r3
+    trace counted ~750 layout-copy ops around these transposes).
     """
-    out, _ = _fwd_impl(q, k, v, scale, block_q, block_k, interpret)
+    out, _ = _fwd_impl(_to_bh(q), _to_bh(k), _to_bh(v), scale,
+                       block_q, block_k, interpret)
     b, lq, h, _ = q.shape
-    return _from_bh(out, b, h)[:, :lq]
+    return _from_bh(out[:, :lq], b, h)
 
 
 def _fwd(q, k, v, scale, block_q, block_k, interpret):
-    out, lse = _fwd_impl(q, k, v, scale, block_q, block_k, interpret,
+    out, lse = _fwd_impl(_to_bh(q), _to_bh(k), _to_bh(v), scale,
+                         block_q, block_k, interpret,
                          save_residuals=True)
     b, lq, h, _ = q.shape
-    return _from_bh(out, b, h)[:, :lq], (q, k, v, out, lse)
+    return _from_bh(out[:, :lq], b, h), (q, k, v, out, lse)
 
 
 def _bwd(scale, block_q, block_k, interpret, res, g):
+    q, k, v, out_bh, lse = res
+    b, _, h, _ = q.shape
+    dq, dk, dv = _bwd_impl(_to_bh(q), _to_bh(k), _to_bh(v), out_bh, lse,
+                           _to_bh(g), scale, block_q, block_k, interpret)
+    return _from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h)
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_bh(q: jax.Array, k: jax.Array, v: jax.Array,
+                       scale: Optional[float] = None,
+                       block_q: Optional[int] = None,
+                       block_k: Optional[int] = None,
+                       interpret: bool = False) -> jax.Array:
+    """Flash attention over [B*H, L, D] tensors — the kernel's native
+    grid layout. A BHLD attention module reshapes [B,H,L,D] here for
+    FREE (B and H are adjacent), eliminating the per-operand transposes
+    the [B,L,H,D] entry point pays."""
+    out, _ = _fwd_impl(q, k, v, scale, block_q, block_k, interpret)
+    return out[:, :q.shape[1]]
+
+
+def _fwd_bh3(q, k, v, scale, block_q, block_k, interpret):
+    out, lse = _fwd_impl(q, k, v, scale, block_q, block_k, interpret,
+                         save_residuals=True)
+    return out[:, :q.shape[1]], (q, k, v, out, lse)
+
+
+def _bwd_bh3(scale, block_q, block_k, interpret, res, g):
     q, k, v, out_bh, lse = res
     return _bwd_impl(q, k, v, out_bh, lse, g, scale, block_q, block_k,
                      interpret)
 
 
-flash_attention.defvjp(_fwd, _bwd)
+flash_attention_bh.defvjp(_fwd_bh3, _bwd_bh3)

@@ -60,14 +60,15 @@ def _use_flash_kernel() -> bool:
 def _hop_fwd_flash(q, k, v, scale, interpret=False):
     """Pallas path: full flash forward with residuals. Returns
     (o [B,Sq,H,D] f32, lse [B,H,Sq] f32)."""
-    from ..ops.flash_attention import _from_bh, _fwd_impl
+    from ..ops.flash_attention import _from_bh, _fwd_impl, _to_bh
     B, Sq, H, D = q.shape
     pad_d = 0 if interpret else (-D) % _LANES
     if pad_d:
         widths = ((0, 0), (0, 0), (0, 0), (0, pad_d))
         q, k, v = (jnp.pad(t, widths) for t in (q, k, v))
-    out_bh, lse_bh = _fwd_impl(q, k, v, scale, 128, 128, interpret,
-                               save_residuals=True)
+    # _fwd_impl operates on the kernel's [B*H, L, D] layout
+    out_bh, lse_bh = _fwd_impl(_to_bh(q), _to_bh(k), _to_bh(v), scale,
+                               128, 128, interpret, save_residuals=True)
     o = _from_bh(out_bh, B, H)[:, :Sq, :, :D].astype(jnp.float32)
     lse = lse_bh[:, :Sq, 0].reshape(B, H, Sq)
     return o, lse
@@ -135,8 +136,15 @@ def _hop_bwd_flash(q, k, v, g, out, lse, scale, interpret=False):
     if pad_q:
         lse_bh = jnp.pad(lse_bh, ((0, 0), (0, pad_q), (0, 0)))
     lse_bh = jnp.broadcast_to(lse_bh, lse_bh.shape[:2] + (lanes,))
-    dq, dk, dv = _bwd_impl(q, k, v, out_bh, lse_bh, g, scale, 128, 128,
-                           interpret=interpret)
+    # _bwd_impl operates on (and returns) the kernel's [B*H, L, D]
+    # layout; hop results go back to [B, L, H, D] for the ring carries
+    from ..ops.flash_attention import _from_bh
+    dq3, dk3, dv3 = _bwd_impl(_to_bh(q), _to_bh(k), _to_bh(v), out_bh,
+                              lse_bh, _to_bh(g), scale, 128, 128,
+                              interpret=interpret)
+    dq = _from_bh(dq3, B, H)
+    dk = _from_bh(dk3, B, H)
+    dv = _from_bh(dv3, B, H)
     return (dq[..., :D].astype(jnp.float32),
             dk[:, :Skv, :, :D].astype(jnp.float32),
             dv[:, :Skv, :, :D].astype(jnp.float32))
