@@ -37,3 +37,12 @@ def test_sampler_trace_harness(tmp_path):
     assert "uncond" in rec["configs"] and "cfg3" in rec["configs"]
     for cfg in rec["configs"].values():
         assert np.isfinite(cfg["latency_ms"])
+
+
+def test_sfc_demo_renders(tmp_path):
+    """The SFC visualization demo (reference demo_hilbert_curve.py
+    analogue) renders and its round-trip check passes."""
+    from scripts.demo_sfc import main
+    out = tmp_path / "sfc.png"
+    assert main(["--grid", "8", "--out", str(out)]) == 0
+    assert out.stat().st_size > 10_000
