@@ -495,24 +495,49 @@ def stage_ddim(args) -> dict:
                               transform=EpsilonPredictionTransform(),
                               sampler=DDIMSampler())
 
-    def run_once(seed):
+    def run_once(seed, n):
         out = engine.generate_samples(
-            params, num_samples=batch, resolution=image_size,
+            params, num_samples=n, resolution=image_size,
             diffusion_steps=steps, rngstate=RngSeq.create(seed))
         # scalar readback, not block_until_ready: the tunneled backend's
         # block_until_ready can return before execution completes (see run())
         float(jnp.sum(out).astype(jnp.float32))
 
-    run_once(0)  # compile
+    run_once(0, batch)  # compile
     times = []
     for i in range(repeats):
         t0 = time.perf_counter()
-        run_once(i + 1)
+        run_once(i + 1, batch)
         times.append(time.perf_counter() - t0)
     med = sorted(times)[len(times) // 2]
     log(f"{key}: {med * 1e3:.1f} ms")
-    return {"platform": jax.devices()[0].platform,
-            "key": key, "latency_ms": round(med * 1e3, 2)}
+    res = {"platform": jax.devices()[0].platform,
+           "key": key, "latency_ms": round(med * 1e3, 2)}
+    if not (cpu or args.quick):
+        # The batch-1 headline is already measured: print it NOW so a
+        # timeout during the batch-8 addendum below (a second compile of
+        # a new shape) can be salvaged by run_stage instead of losing
+        # the whole stage.
+        print(json.dumps(res), flush=True)
+        # throughput at batch 8: batch-1 inference runs ~11.5x above its
+        # compute floor (tiny per-step matmuls — docs/ROUND4.md analytic
+        # floor); batching is the honest recovery lever, so record it
+        bt = 8
+        try:
+            run_once(100, bt)   # compile the batched program
+            bt_times = []
+            for i in range(3):   # median like the batch-1 number —
+                t0 = time.perf_counter()   # one stall must not become
+                run_once(101 + i, bt)      # the recorded evidence
+                bt_times.append(time.perf_counter() - t0)
+            dt = sorted(bt_times)[1]
+            res["batch8_latency_ms"] = round(dt * 1e3, 2)
+            res["batch8_imgs_per_sec"] = round(bt / dt, 3)
+            log(f"ddim batch8: {dt * 1e3:.1f} ms "
+                f"({bt / dt:.2f} imgs/s)")
+        except Exception as e:
+            res["batch8_error"] = f"{type(e).__name__}: {e}"[:160]
+    return res
 
 
 def stage_attnpad(args) -> dict:
@@ -941,6 +966,20 @@ def run_stage(name: str, args, env, timeout_s: int, retries: int,
         except subprocess.TimeoutExpired:
             child.kill()
             out_txt, err_txt = child.communicate()
+            # salvage: stages print their result-so-far before starting
+            # risky addenda (e.g. ddim's batch-8 compile) — a killed
+            # child may still have left a complete JSON line
+            for line in reversed((out_txt or "").strip().splitlines()):
+                try:
+                    out = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                out["status"] = "ok"
+                out["salvaged"] = f"timeout after {attempt_timeout}s"
+                out["secs"] = round(time.monotonic() - t0, 1)
+                log(f"stage {name}: timed out but salvaged a completed "
+                    "result line")
+                return out
             # keep the child's partial stderr: it says which phase
             # (build, warmup, batch N, trace) the stage wedged in
             tail = (err_txt or "")[-300:]
