@@ -435,22 +435,62 @@ def stage_sweep256(args) -> dict:
 
 
 def stage_ref(args) -> dict:
-    """Reference-execution-semantics baseline on the same hardware."""
+    """Reference-execution-semantics baseline on the same hardware.
+
+    Headline cell is the reference's documented batch 16; a small batch
+    sweep also records the baseline at ITS best batch so the vs_baseline
+    ratio can be quoted at matched best-effort, not only at the
+    reference's pinned config (VERDICT r3 weak #8)."""
     _apply_jax_platforms()
     import jax
     cpu = jax.devices()[0].platform == "cpu"
     image_size = 64 if cpu else IMAGE_SIZE
-    batch = 4 if cpu else BASELINE_BATCH
     timed = 5 if cpu else (10 if args.quick else TIMED_STEPS)
+    sweep = ((4,) if cpu else
+             (BASELINE_BATCH,) if args.quick else (16, 32, 64))
     log("building reference-style trainer (f32, XLA attn, per-step sync)...")
     ref = build_trainer(tpu_native=False, image_size=image_size)
-    ips, step_time, _ = run(ref, make_batches(batch, image_size), batch,
-                            sync_every_step=True, timed_steps=timed)
-    log(f"reference-style: {ips:.2f} imgs/sec/chip @ batch {batch}")
-    return {"platform": jax.devices()[0].platform,
-            "imgs_per_sec_per_chip": round(ips, 3),
-            "batch_per_chip": batch,
-            "step_time_ms": round(step_time * 1e3, 2)}
+    per_batch = {}
+    for batch in sweep:
+        try:
+            ips, step_time, _ = run(ref, make_batches(batch, image_size),
+                                    batch, sync_every_step=True,
+                                    timed_steps=timed)
+            per_batch[str(batch)] = {
+                "imgs_per_sec_per_chip": round(ips, 3),
+                "step_time_ms": round(step_time * 1e3, 2)}
+            log(f"reference-style batch {batch}: {ips:.2f} imgs/sec/chip")
+        except Exception as e:
+            per_batch[str(batch)] = {
+                "error": f"{type(e).__name__}: {e}"[:240]}
+            log(f"reference-style batch {batch}: FAILED {e}"[:200])
+            aborted = (f"backend died at batch {batch}"
+                       if _backend_died(e) else None)
+            break
+    else:
+        aborted = None
+    ok = {b: c for b, c in per_batch.items()
+          if "imgs_per_sec_per_chip" in c}
+    if not ok:
+        return {"platform": jax.devices()[0].platform,
+                "per_batch": per_batch,
+                "aborted": aborted or "every batch failed"}
+    head = str(sweep[0])
+    best_b = max(ok, key=lambda b: ok[b]["imgs_per_sec_per_chip"])
+    res = {"platform": jax.devices()[0].platform, "per_batch": per_batch,
+           "best_batch": int(best_b)}
+    if aborted:
+        # the baseline's true best batch may never have been measured:
+        # publishing best_* would overstate vs_baseline_best
+        res["aborted"] = aborted
+    else:
+        res["best_imgs_per_sec_per_chip"] = \
+            ok[best_b]["imgs_per_sec_per_chip"]
+    src = head if head in ok else best_b   # documented-config headline
+    res["imgs_per_sec_per_chip"] = ok[src]["imgs_per_sec_per_chip"]
+    res["batch_per_chip"] = int(src)
+    res["step_time_ms"] = ok[src]["step_time_ms"]
+    return res
 
 
 def stage_ddim(args) -> dict:
@@ -812,7 +852,7 @@ STAGE_ORDER = ("sweep", "ref", "flashtune", "ddim", "attnpad",
 # rough healthy-tunnel cost estimates (seconds) for budget scheduling —
 # a stage is skipped when the remaining budget can't cover its MINIMUM
 # useful runtime (est/2), and its timeout is capped by what remains
-STAGE_EST = {"sweep": 900, "ref": 250, "flashtune": 150, "ddim": 600,
+STAGE_EST = {"sweep": 900, "ref": 450, "flashtune": 150, "ddim": 600,
              "attnpad": 90, "ablate": 900, "sweep256": 800,
              "longseq": 400}
 
@@ -919,6 +959,11 @@ def probe_backend(timeout_s: int, budget_s: int, env=None) -> dict:
 
 # the stage subprocess currently on the tunnel (for the SIGTERM handler)
 _ACTIVE_CHILD = [None]
+# monotonic time of the last killed child: a kill leaks its tunnel lease
+# for ~10-20 min (probe_backend rationale), so the orchestrator spaces
+# the NEXT launch — whether the kill ended in a salvage, an abandoned
+# retry, or a failure
+_LAST_KILL_AT = [0.0]
 
 
 def run_stage(name: str, args, env, timeout_s: int, retries: int,
@@ -965,6 +1010,7 @@ def run_stage(name: str, args, env, timeout_s: int, retries: int,
                                                out_txt, err_txt)
         except subprocess.TimeoutExpired:
             child.kill()
+            _LAST_KILL_AT[0] = time.monotonic()
             out_txt, err_txt = child.communicate()
             # salvage: stages print their result-so-far before starting
             # risky addenda (e.g. ddim's batch-8 compile) — a killed
@@ -974,6 +1020,8 @@ def run_stage(name: str, args, env, timeout_s: int, retries: int,
                     out = json.loads(line)
                 except json.JSONDecodeError:
                     continue
+                if not isinstance(out, dict):
+                    continue   # a stray 'null'/number line is not a result
                 out["status"] = "ok"
                 out["salvaged"] = f"timeout after {attempt_timeout}s"
                 out["secs"] = round(time.monotonic() - t0, 1)
@@ -1139,7 +1187,6 @@ def main():
                 "status": f"skipped: budget ({int(max(left(), 0))}s left, "
                           f"stage needs ~{est}s)"}
         else:
-            timeout = int(min(est * 2, left() - 60))
             stage_env = dict(env)
             if name in TUNED_STAGES:
                 # measured flashtune winner reaches the diagnostics; the
@@ -1150,6 +1197,20 @@ def main():
                     if isinstance(v, dict)})
                 if added:
                     log(f"stage {name}: tuned env {added}")
+            # a recently-killed child still holds its tunnel lease: give
+            # it time to expire before the next stage's backend init
+            # (budget-capped — on a tight budget, launching into a
+            # possibly-wedged tunnel beats spending the remainder asleep)
+            since_kill = time.monotonic() - _LAST_KILL_AT[0]
+            if _LAST_KILL_AT[0] and since_kill < PROBE_COOLDOWN_S:
+                naptime = min(PROBE_COOLDOWN_S - since_kill,
+                              max(left() - est, 0))
+                if naptime > 5:
+                    log(f"cooling down {int(naptime)}s after a killed "
+                        "stage child (leaked-lease window)")
+                    time.sleep(naptime)
+            # timeout AFTER the cooldown nap so it reflects what remains
+            timeout = int(min(est * 2, left() - 60))
             log(f"=== stage {name} (timeout {timeout}s, "
                 f"{'inf' if left() == float('inf') else int(left())}s "
                 "budget left) ===")
@@ -1174,6 +1235,12 @@ def main():
                 and ref.get("imgs_per_sec_per_chip"):
             result["vs_baseline"] = round(
                 result["value"] / ref["imgs_per_sec_per_chip"], 3)
+            if ref.get("best_imgs_per_sec_per_chip"):
+                # matched best-effort: our best batch vs the baseline's
+                # best batch (VERDICT r3 weak #8)
+                result["vs_baseline_best"] = round(
+                    result["value"] / ref["best_imgs_per_sec_per_chip"],
+                    3)
         ddim = result["stages"].get("ddim", {})
         if ddim.get("status") == "ok" and ddim.get("key"):
             result[ddim["key"]] = ddim.get("latency_ms")
