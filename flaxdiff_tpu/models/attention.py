@@ -197,6 +197,7 @@ class BasicTransformerBlock(nn.Module):
     force_fp32_for_softmax: bool = True
     only_pure_attention: bool = False
     use_cross_only: bool = False
+    bhld: Optional[bool] = None
     kernel_init: Callable = kernel_init(1.0)
 
     @nn.compact
@@ -205,7 +206,7 @@ class BasicTransformerBlock(nn.Module):
             heads=self.heads, dim_head=self.dim_head, backend=self.backend,
             dtype=self.dtype, precision=self.precision, use_bias=self.use_bias,
             force_fp32_for_softmax=self.force_fp32_for_softmax,
-            kernel_init=self.kernel_init, name=name)
+            bhld=self.bhld, kernel_init=self.kernel_init, name=name)
         ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)
         if self.only_pure_attention:
             return attn("attn1")(ln("norm1")(x),
@@ -235,6 +236,7 @@ class TransformerBlock(nn.Module):
     only_pure_attention: bool = False
     use_self_and_cross: bool = True
     force_fp32_for_softmax: bool = True
+    bhld: Optional[bool] = None
     kernel_init: Callable = kernel_init(1.0)
 
     @nn.compact
@@ -257,7 +259,8 @@ class TransformerBlock(nn.Module):
                 force_fp32_for_softmax=self.force_fp32_for_softmax,
                 only_pure_attention=self.only_pure_attention,
                 use_cross_only=not self.use_self_and_cross and context is not None,
-                kernel_init=self.kernel_init, name=f"block_{i}")(
+                bhld=self.bhld, kernel_init=self.kernel_init,
+                name=f"block_{i}")(
                 x, context=context)
         if self.use_projection:
             x = nn.Dense(c, dtype=self.dtype, precision=self.precision,

@@ -82,6 +82,7 @@ class Unet(nn.Module):
                 use_self_and_cross=cfg.get("use_self_and_cross", True),
                 only_pure_attention=cfg.get("only_pure_attention", False),
                 force_fp32_for_softmax=cfg.get("force_fp32_for_softmax", True),
+                bhld=cfg.get("bhld", None),
                 dtype=self.dtype, precision=self.precision, name=name)
 
         x = ConvLayer(self.conv_type, self.feature_depths[0], (3, 3), 1,

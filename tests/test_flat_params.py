@@ -138,3 +138,32 @@ def test_flat_params_sampler_roundtrip():
         diffusion_steps=4, rngstate=RngSeq.create(0), channels=1)
     assert out.shape == (2, size, size, 1)
     assert bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_flat_params_with_grad_accum():
+    """optax.MultiSteps over the flat vectors (CLI --grad_accum path):
+    accumulation is per-leaf elementwise, so it composes with flat
+    state; k micro-steps per optimizer update must still train."""
+    size = 8
+    model = Unet(output_channels=1, emb_features=16,
+                 feature_depths=(8, 16), attention_configs=(None, None),
+                 num_res_blocks=1, norm_groups=4)
+
+    def apply_fn(params, x, t, cond):
+        return model.apply({"params": params}, x, t, None)
+
+    def init_fn(key):
+        return model.init(key, jnp.zeros((1, size, size, 1)),
+                          jnp.zeros((1,)), None)["params"]
+
+    trainer = DiffusionTrainer(
+        apply_fn=apply_fn, init_fn=init_fn,
+        tx=optax.MultiSteps(optax.adamw(1e-3), every_k_schedule=2),
+        schedule=CosineNoiseSchedule(timesteps=100),
+        transform=EpsilonPredictionTransform(),
+        mesh=create_mesh(axes={"data": -1}),
+        config=TrainerConfig(log_every=1, uncond_prob=0.0,
+                             flat_params=True))
+    losses = [float(trainer.train_step(trainer.put_batch(b)))
+              for b in _batches(size, n=4)]
+    assert all(np.isfinite(losses))
