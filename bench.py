@@ -271,9 +271,27 @@ def _sweep_body(image_size: int, depths: tuple,
             best = (ips, batch, step_time, flops, remat)
         return True
 
+    def print_progress():
+        # a complete result-so-far line on stdout: if the stage is
+        # killed later (timeout, wedge), run_stage salvages this line
+        # instead of losing the measured cells
+        line = {"platform": jax.devices()[0].platform,
+                "image_size": image_size, "per_batch": dict(per_batch)}
+        if best is not None:
+            ips_b, batch_b, st_b, fl_b, rm_b = best
+            line.update(
+                imgs_per_sec_per_chip=round(ips_b, 3),
+                batch_per_chip=batch_b, remat=rm_b,
+                step_time_ms=round(st_b * 1e3, 2),
+                mfu_hw=(round(mfu(fl_b, st_b, peak), 4)
+                        if fl_b and peak else None))
+        print(json.dumps(line), flush=True)
+
     failures = 0
     for batch in sweep:
-        if attempt(batch, remat=False):
+        ok_plain = attempt(batch, remat=False)
+        print_progress()
+        if ok_plain:
             failures = 0
             continue
         if aborted:
@@ -282,6 +300,7 @@ def _sweep_body(image_size: int, depths: tuple,
         # answers "was that memory?" (remat trades FLOPs for activation
         # memory, the knob exists on every block family)
         ok_r = attempt(batch, remat=True)
+        print_progress()
         if aborted:
             break
         failures = 0 if ok_r else failures + 1
@@ -755,6 +774,7 @@ def stage_ablate(args) -> dict:
                 res["configs"][key] = {
                     "error": f"{type(e).__name__}: {e}"[:160]}
             log(f"ablate {key}: {res['configs'][key]}")
+            print(json.dumps(res), flush=True)   # salvage point
     os.environ.pop("FLAXDIFF_FUSED_NORM", None)
     # optimizer-path configs at default kernels: flat_opt fuses only the
     # optax transform (EMA + apply_updates stay leaf-wise); flat_params
@@ -788,6 +808,7 @@ def stage_ablate(args) -> dict:
             for ek in env_add:
                 os.environ.pop(ek, None)
         log(f"ablate {key}: {res['configs'][key]}")
+        print(json.dumps(res), flush=True)   # salvage point
     ok = {kk: vv for kk, vv in res["configs"].items()
           if "imgs_per_sec_per_chip" in vv}
     if ok:
