@@ -23,6 +23,7 @@ from ..utils import RngSeq
 from .registry import build_model
 
 CONFIG_FILENAME = "pipeline_config.json"
+from ..trainer.optim import TEMPLATE_FILENAME  # noqa: E402
 
 
 class DiffusionInferencePipeline:
@@ -145,6 +146,28 @@ class DiffusionInferencePipeline:
         params = state["params"]
         ema = state.get("ema_params")
         ckpt.close()
+
+        # a flat-params run (TrainerConfig.flat_params) checkpoints the
+        # state as per-dtype vectors; the training CLI saved the param
+        # template beside the config, so inference restores the
+        # structured tree the model expects
+        from ..trainer.optim import (deserialize_template, is_flat_params,
+                                     unflatten_params)
+        # the config flag is authoritative; the structural heuristic
+        # covers checkpoints written before the flag existed
+        if config.get("flat_params") or is_flat_params(params):
+            tmpl_path = os.path.join(checkpoint_dir, TEMPLATE_FILENAME)
+            if not os.path.exists(tmpl_path):
+                raise FileNotFoundError(
+                    f"{checkpoint_dir} holds a flat-params checkpoint "
+                    f"but no {TEMPLATE_FILENAME}; re-save from the "
+                    "trainer (train.py writes it automatically) or "
+                    "unflatten manually with trainer.optim")
+            with open(tmpl_path) as f:
+                template = deserialize_template(json.load(f))
+            params = unflatten_params(template, params)
+            if ema is not None and is_flat_params(ema):
+                ema = unflatten_params(template, ema)
         return DiffusionInferencePipeline.from_config(
             config, params=params, ema_params=ema, autoencoder=autoencoder)
 

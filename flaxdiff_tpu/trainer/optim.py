@@ -90,6 +90,53 @@ def param_template(params_or_shapes: PyTree) -> PyTree:
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params_or_shapes)
 
 
+TEMPLATE_FILENAME = "param_template.json"
+
+
+def is_flat_params(tree) -> bool:
+    """True when `tree` is the flat-state layout: a dict keyed by dtype
+    names holding 1-D vectors (what a flat_params=True run checkpoints,
+    rather than the structured module tree)."""
+    if not isinstance(tree, dict) or not tree:
+        return False
+    for k, v in tree.items():
+        if not isinstance(k, str) or getattr(v, "ndim", None) != 1:
+            return False
+        try:
+            if jnp.dtype(k).name != k:
+                return False
+        except TypeError:
+            return False
+    return True
+
+
+def serialize_template(template: PyTree) -> list:
+    """JSON-able [(keypath, shape, dtype)] of a param template —
+    persisted next to a flat-params checkpoint so inference can
+    unflatten it without rebuilding the model at the training
+    resolution (some architectures' param shapes depend on it)."""
+    import jax
+
+    return [["/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                      for p in path),
+             list(leaf.shape), jnp.dtype(leaf.dtype).name]
+            for path, leaf in
+            jax.tree_util.tree_flatten_with_path(template)[0]]
+
+
+def deserialize_template(entries: list) -> PyTree:
+    """Inverse of serialize_template: nested-dict tree of
+    ShapeDtypeStruct leaves."""
+    root: dict = {}
+    for keypath, shape, dtype in entries:
+        node = root
+        parts = keypath.split("/")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = jax.ShapeDtypeStruct(tuple(shape), dtype)
+    return root
+
+
 class FlatOptState(NamedTuple):
     inner: optax.OptState
 

@@ -155,6 +155,31 @@ class DiffusionTrainer:
         self.best_state: Optional[TrainState] = None
         self._step_flops: Dict[Any, Optional[float]] = {}
 
+        if self._param_template is not None and checkpointer is not None:
+            # flat-state checkpoints are unreadable without the template
+            # (inference/pipeline.py from_checkpoint): persist it beside
+            # the shards from whoever owns the flat state — every
+            # producer, not just the CLI
+            self._write_param_template()
+
+    def _write_param_template(self):
+        import json as _json
+        import os as _os
+
+        from .optim import TEMPLATE_FILENAME, serialize_template
+        if jax.process_index() != 0:
+            return
+        path = _os.path.join(self.checkpointer.directory,
+                             TEMPLATE_FILENAME)
+        try:
+            with open(path, "w") as f:
+                _json.dump(serialize_template(self._param_template), f)
+        except OSError as e:   # e.g. object-store path without fsspec
+            import warnings
+            warnings.warn(f"could not write {path}: {e}; flat-params "
+                          "checkpoints need it for inference restore",
+                          stacklevel=2)
+
     # -- profiling -----------------------------------------------------------
     def step_flops(self, global_batch: PyTree) -> Optional[float]:
         """Per-device FLOPs of the compiled train step (XLA cost analysis);

@@ -134,3 +134,21 @@ def test_cli_latent_diffusion_sd_vae_npz(tmp_path):
         "--autoencoder_opts", json.dumps({"npz": str(npz),
                                           "norm_groups": 4}))
     assert np.isfinite(hist["final_loss"])
+
+
+def test_cli_flat_params_checkpoint_to_inference(tmp_path):
+    """--flat_params trains, checkpoints flat per-dtype vectors, and
+    DiffusionInferencePipeline.from_checkpoint unflattens via the saved
+    param template and samples (the flat layout must never strand a
+    checkpoint outside the inference path)."""
+    hist = _run(tmp_path, "--dataset", "synthetic", "--flat_params",
+                "--save_every", "2")
+    assert np.isfinite(hist["final_loss"])
+    ckpt_dir = str(tmp_path / "ckpt")
+    assert (tmp_path / "ckpt" / "param_template.json").exists()
+
+    from flaxdiff_tpu.inference import DiffusionInferencePipeline
+    pipe = DiffusionInferencePipeline.from_checkpoint(ckpt_dir)
+    out = pipe.generate_samples(num_samples=2, resolution=16,
+                                diffusion_steps=2, sampler="ddim")
+    assert out.shape[0] == 2 and bool(np.isfinite(np.asarray(out)).all())

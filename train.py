@@ -424,7 +424,10 @@ def main(argv=None):
         "autoencoder": ({"name": args.autoencoder,
                          **autoencoder.serialize()}
                         if autoencoder else None),
+        "flat_params": args.flat_params,
     })
+    # (flat-params runs: the trainer itself persists param_template.json
+    # beside the checkpoints — see DiffusionTrainer._write_param_template)
 
     validator = None
     if args.val_every:
