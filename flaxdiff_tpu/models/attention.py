@@ -95,6 +95,37 @@ class _ProjFromHeads(nn.Module):
         return y
 
 
+def head_projection(bhld: bool, *, heads: int, dim_head: int,
+                    use_bias: bool, dtype, precision, kernel_init,
+                    name: str) -> nn.Module:
+    """The q/k/v projection for a layout: [B,L,C]->[B,L,H,D]
+    (DenseGeneral) or ->[B,H,L,D] (_ProjToHeads). One constructor shared
+    by every attention module so the two layouts cannot drift (same
+    param names/shapes AND the caller's exact init in both)."""
+    if bhld:
+        return _ProjToHeads(heads=heads, dim_head=dim_head,
+                            use_bias=use_bias, dtype=dtype,
+                            precision=precision, kernel_init=kernel_init,
+                            name=name)
+    return nn.DenseGeneral((heads, dim_head), use_bias=use_bias,
+                           dtype=dtype, precision=precision,
+                           kernel_init=kernel_init, name=name)
+
+
+def head_out_projection(bhld: bool, *, features: int, heads: int,
+                        dim_head: int, use_bias: bool, dtype, precision,
+                        kernel_init, name: str = "to_out") -> nn.Module:
+    """The output projection back to [B,L,C] for either layout."""
+    if bhld:
+        return _ProjFromHeads(features=features, heads=heads,
+                              dim_head=dim_head, use_bias=use_bias,
+                              dtype=dtype, precision=precision,
+                              kernel_init=kernel_init, name=name)
+    return nn.DenseGeneral(features, axis=(-2, -1), use_bias=use_bias,
+                           dtype=dtype, precision=precision,
+                           kernel_init=kernel_init, name=name)
+
+
 class AttentionLayer(nn.Module):
     """Multi-head self/cross attention over [B, L, C] (+[B,H,W,C] auto-flatten).
 
@@ -127,40 +158,23 @@ class AttentionLayer(nn.Module):
         context = x if context is None else context
         bhld = (self.bhld if self.bhld is not None
                 else os.environ.get("FLAXDIFF_ATTN_BHLD") == "1")
-        if bhld:
-            proj = lambda name: _ProjToHeads(
-                heads=self.heads, dim_head=self.dim_head,
-                use_bias=self.use_bias, dtype=self.dtype,
-                precision=self.precision, kernel_init=self.kernel_init,
-                name=name)
-            q = proj("to_q")(x)
-            k = proj("to_k")(context)
-            v = proj("to_v")(context)
-            out = dot_product_attention_bhld(
-                q, k, v, backend=self.backend,
-                force_fp32_for_softmax=self.force_fp32_for_softmax)
-            out = _ProjFromHeads(
-                features=x.shape[-1], heads=self.heads,
-                dim_head=self.dim_head, use_bias=self.use_bias,
-                dtype=self.dtype, precision=self.precision,
-                kernel_init=self.kernel_init, name="to_out")(out)
-            if spatial:
-                out = out.reshape(b, h, w, c)
-            return out
-        dense = lambda name: nn.DenseGeneral(
-            (self.heads, self.dim_head), use_bias=self.use_bias,
+        proj = lambda name: head_projection(
+            bhld, heads=self.heads, dim_head=self.dim_head,
+            use_bias=self.use_bias, dtype=self.dtype,
+            precision=self.precision, kernel_init=self.kernel_init,
+            name=name)
+        q = proj("to_q")(x)
+        k = proj("to_k")(context)
+        v = proj("to_v")(context)
+        attend = (dot_product_attention_bhld if bhld
+                  else dot_product_attention)
+        out = attend(q, k, v, backend=self.backend,
+                     force_fp32_for_softmax=self.force_fp32_for_softmax)
+        out = head_out_projection(
+            bhld, features=x.shape[-1], heads=self.heads,
+            dim_head=self.dim_head, use_bias=self.use_bias,
             dtype=self.dtype, precision=self.precision,
-            kernel_init=self.kernel_init, name=name)
-        q = dense("to_q")(x)
-        k = dense("to_k")(context)
-        v = dense("to_v")(context)
-        out = dot_product_attention(
-            q, k, v, backend=self.backend,
-            force_fp32_for_softmax=self.force_fp32_for_softmax)
-        out = nn.DenseGeneral(
-            x.shape[-1], axis=(-2, -1), use_bias=self.use_bias,
-            dtype=self.dtype, precision=self.precision,
-            kernel_init=self.kernel_init, name="to_out")(out)
+            kernel_init=self.kernel_init)(out)
         if spatial:
             out = out.reshape(b, h, w, c)
         return out

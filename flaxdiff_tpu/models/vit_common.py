@@ -22,8 +22,11 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import dot_product_attention
+import os
+
+from ..ops.attention import dot_product_attention, dot_product_attention_bhld
 from ..typing import Dtype
+from .attention import head_out_projection, head_projection
 from .common import FourierEmbedding, TimeProjection
 from .sfc import (
     build_2d_sincos_pos_embed,
@@ -93,11 +96,17 @@ def identity_rope(dim: int, seq_len: int) -> Tuple[jax.Array, jax.Array]:
     return jnp.ones(shape, jnp.float32), jnp.zeros(shape, jnp.float32)
 
 
-def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
-    """Rotate-half RoPE on [B, S, H, D] with tables [S, D//2]
-    (reference vit_common.py:56-84)."""
-    cos = jnp.concatenate([cos, cos], axis=-1)[None, :, None, :]
-    sin = jnp.concatenate([sin, sin], axis=-1)[None, :, None, :]
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
+               bhld: bool = False) -> jax.Array:
+    """Rotate-half RoPE with tables [S, D//2] (reference
+    vit_common.py:56-84). Position-elementwise, so it applies in either
+    layout: [B, S, H, D] (default) or [B, H, S, D] (bhld=True)."""
+    if bhld:
+        cos = jnp.concatenate([cos, cos], axis=-1)[None, None, :, :]
+        sin = jnp.concatenate([sin, sin], axis=-1)[None, None, :, :]
+    else:
+        cos = jnp.concatenate([cos, cos], axis=-1)[None, :, None, :]
+        sin = jnp.concatenate([sin, sin], axis=-1)[None, :, None, :]
     half = x.shape[-1] // 2
     rotated = jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
     return (x * cos + rotated * sin).astype(x.dtype)
@@ -114,6 +123,10 @@ class RoPEAttention(nn.Module):
     precision: Optional[jax.lax.Precision] = None
     use_bias: bool = True
     force_fp32_for_softmax: bool = True
+    # None: read FLAXDIFF_ATTN_BHLD (models/attention.py AttentionLayer
+    # rationale — RoPE is position-elementwise, so it rotates in either
+    # layout and the DiT family gets the transpose-free kernel path too)
+    bhld: Optional[bool] = None
     out_kernel_init: Optional[nn.initializers.Initializer] = None
 
     @nn.compact
@@ -125,30 +138,41 @@ class RoPEAttention(nn.Module):
             b, h, w, c = x.shape
             x = x.reshape(b, h * w, c)
         context = x if context is None else context
-        dense = lambda name: nn.DenseGeneral(
-            (self.heads, self.dim_head), use_bias=self.use_bias,
-            dtype=self.dtype, precision=self.precision, name=name)
-        q = dense("to_q")(x)
-        k = dense("to_k")(context)
-        v = dense("to_v")(context)
+        bhld = (self.bhld if self.bhld is not None
+                else os.environ.get("FLAXDIFF_ATTN_BHLD") == "1")
+        # shared layout-dispatching constructors (models/attention.py):
+        # same init in both layouts — here DenseGeneral's lecun default
+        proj = lambda name: head_projection(
+            bhld, heads=self.heads, dim_head=self.dim_head,
+            use_bias=self.use_bias, dtype=self.dtype,
+            precision=self.precision,
+            kernel_init=nn.linear.default_kernel_init, name=name)
+        q = proj("to_q")(x)
+        k = proj("to_k")(context)
+        v = proj("to_v")(context)
+        seq_axis = 2 if bhld else 1
         if freqs_cis is None:
             # Size the default table to the longest sequence so cross-attention
             # with a longer context gets valid positions for every key.
             cos, sin = rope_frequencies(
-                self.dim_head, max(q.shape[1], k.shape[1]))
+                self.dim_head, max(q.shape[seq_axis], k.shape[seq_axis]))
         else:
             cos, sin = freqs_cis
-        q = apply_rope(q, cos[: q.shape[1]], sin[: q.shape[1]])
-        k = apply_rope(k, cos[: k.shape[1]], sin[: k.shape[1]])
-        out = dot_product_attention(
-            q, k, v, backend=self.backend,
-            force_fp32_for_softmax=self.force_fp32_for_softmax)
+        q = apply_rope(q, cos[: q.shape[seq_axis]],
+                       sin[: q.shape[seq_axis]], bhld=bhld)
+        k = apply_rope(k, cos[: k.shape[seq_axis]],
+                       sin[: k.shape[seq_axis]], bhld=bhld)
         out_init = (self.out_kernel_init if self.out_kernel_init is not None
                     else nn.linear.default_kernel_init)
-        out = nn.DenseGeneral(
-            x.shape[-1], axis=(-2, -1), use_bias=self.use_bias,
+        attend = (dot_product_attention_bhld if bhld
+                  else dot_product_attention)
+        out = attend(q, k, v, backend=self.backend,
+                     force_fp32_for_softmax=self.force_fp32_for_softmax)
+        out = head_out_projection(
+            bhld, features=x.shape[-1], heads=self.heads,
+            dim_head=self.dim_head, use_bias=self.use_bias,
             dtype=self.dtype, precision=self.precision,
-            kernel_init=out_init, name="to_out")(out)
+            kernel_init=out_init)(out)
         if spatial:
             out = out.reshape(b, h, w, c)
         return out

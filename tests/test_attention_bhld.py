@@ -108,3 +108,53 @@ def test_bhld_env_toggle(monkeypatch):
     out_on = layer.apply({"params": params}, x)
     np.testing.assert_allclose(np.asarray(out_off), np.asarray(out_on),
                                rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("cross", [False, True])
+def test_rope_attention_layouts_agree(cross):
+    """RoPEAttention (the DiT family's attention) with one param tree in
+    both layouts — RoPE is position-elementwise, so the rotation is
+    layout-independent."""
+    from flaxdiff_tpu.models.vit_common import RoPEAttention
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(2, 16, 12)), jnp.float32)
+    ctx = (jnp.asarray(rng.normal(size=(2, 9, 12)), jnp.float32)
+           if cross else None)
+    mk = lambda bhld: RoPEAttention(heads=2, dim_head=8, backend="xla",
+                                    bhld=bhld)
+    params = mk(False).init(jax.random.PRNGKey(0), x, ctx)["params"]
+    out_ref = mk(False).apply({"params": params}, x, ctx)
+    out_bh = mk(True).apply({"params": params}, x, ctx)
+    np.testing.assert_allclose(np.asarray(out_ref), np.asarray(out_bh),
+                               rtol=2e-5, atol=2e-6)
+
+    def loss(p, bhld):
+        return jnp.sum(mk(bhld).apply({"params": p}, x, ctx) ** 2)
+
+    g_ref = jax.grad(loss)(params, False)
+    g_bh = jax.grad(loss)(params, True)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5),
+        g_ref, g_bh)
+
+
+def test_fresh_inits_are_layout_identical():
+    """Same seed, both layouts, BOTH module families: bit-identical
+    fresh params (the projections wrap the same init on the same
+    flattened shape under the same param RNG path — a narrower init in
+    one layout would silently confound from-scratch comparisons)."""
+    from flaxdiff_tpu.models.vit_common import RoPEAttention
+
+    x = jnp.ones((1, 16, 12))
+    for mk in (lambda b: AttentionLayer(heads=2, dim_head=8,
+                                        backend="xla", bhld=b),
+               lambda b: RoPEAttention(heads=2, dim_head=8,
+                                       backend="xla", bhld=b)):
+        p_ref = mk(False).init(jax.random.PRNGKey(5), x)["params"]
+        p_bh = mk(True).init(jax.random.PRNGKey(5), x)["params"]
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+            p_ref, p_bh)
