@@ -789,7 +789,11 @@ def stage_ablate(args) -> dict:
             # free reshapes into the kernel's native [B*H,L,D] grid —
             # measures the r3 trace's ~750 layout-copy claim in-context
             ("attn=flash,norm=pallas,layout=bhld", {},
-             {"FLAXDIFF_ATTN_BHLD": "1"})):
+             {"FLAXDIFF_ATTN_BHLD": "1"}),
+            # both optimizations at once — the expected next default if
+            # each wins alone
+            ("attn=flash,norm=pallas,opt=flatparams,layout=bhld",
+             dict(flat_params=True), {"FLAXDIFF_ATTN_BHLD": "1"})):
         try:
             for ek, ev in env_add.items():
                 os.environ[ek] = ev
