@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Flat-parameter state + BHLD attention layout: the round-4 MFU levers
+(no reference analogue — the reference is replicated-param pmap DP).
+
+Two orthogonal TPU optimizations, both checkpoint-compatible with the
+defaults:
+
+- `TrainerConfig(flat_params=True)`: params, EMA, and optimizer state
+  live as ONE padded vector per dtype. The model unflattens inside the
+  loss, so AD's transpose returns gradients already flat; every
+  optimizer/EMA/apply update runs as a few fused HBM-floor kernels
+  instead of two launch-bound kernels per leaf (~12% of the r3 on-chip
+  step), and the vectors shard perfectly evenly over the `fsdp` axis.
+- `bhld=True` on the attention config: q/k/v are projected straight
+  into the flash kernel's native [B, H, L, D] layout — the head
+  permutation rides the projection matmul, so no transposes are
+  materialized around the pallas custom calls (~750 copy ops/step in
+  the r3 trace). Parameters are identical across layouts.
+
+This example trains a text-conditioned UNet with BOTH on an
+8-virtual-device (data x fsdp) CPU mesh, checks the state really is a
+handful of flat sharded vectors, and round-trips sampling through
+`get_params` (which returns the structured tree the samplers expect).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--image_size", type=int, default=16)
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.steps = 4
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from flaxdiff_tpu.models.unet import Unet
+    from flaxdiff_tpu.parallel import create_mesh
+    from flaxdiff_tpu.predictors import EpsilonPredictionTransform
+    from flaxdiff_tpu.samplers import DDIMSampler, DiffusionSampler
+    from flaxdiff_tpu.schedulers import CosineNoiseSchedule
+    from flaxdiff_tpu.trainer import DiffusionTrainer, TrainerConfig
+    from flaxdiff_tpu.utils import RngSeq
+
+    size, ctx_len, ctx_dim = args.image_size, 8, 16
+    attn = {"heads": 2, "dim_head": 8, "backend": "auto", "bhld": True}
+    model = Unet(output_channels=3, emb_features=32,
+                 feature_depths=(16, 32),
+                 attention_configs=(None, dict(attn)),
+                 num_res_blocks=1, norm_groups=8)
+
+    def apply_fn(params, x, t, cond):
+        text = (cond["text"] if cond else
+                jnp.zeros((x.shape[0], ctx_len, ctx_dim), x.dtype))
+        return model.apply({"params": params}, x, t, text)
+
+    def init_fn(key):
+        return model.init(key, jnp.zeros((1, size, size, 3)),
+                          jnp.zeros((1,)),
+                          jnp.zeros((1, ctx_len, ctx_dim)))["params"]
+
+    mesh = create_mesh(axes={"data": 2, "fsdp": 4})
+    trainer = DiffusionTrainer(
+        apply_fn=apply_fn, init_fn=init_fn,
+        tx=optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(2e-3)),
+        schedule=CosineNoiseSchedule(timesteps=1000),
+        transform=EpsilonPredictionTransform(), mesh=mesh,
+        config=TrainerConfig(log_every=10, uncond_prob=0.1,
+                             flat_params=True),
+        null_cond={"text": np.zeros((1, ctx_len, ctx_dim), np.float32)})
+
+    # the state really is a handful of flat vectors
+    leaves = jax.tree_util.tree_leaves(trainer.state.params)
+    assert all(v.ndim == 1 for v in leaves), "state must be flat vectors"
+    print(f"flat state: {len(leaves)} vector(s), "
+          f"{sum(v.size for v in leaves):,} elements "
+          f"(structured tree would hold "
+          f"{len(jax.tree_util.tree_leaves(init_fn(jax.random.PRNGKey(0))))}"
+          " leaves)")
+
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return {"sample": rng.normal(
+                    size=(args.batch, size, size, 3)).astype(np.float32),
+                "cond": {"text": rng.normal(
+                    size=(args.batch, ctx_len, ctx_dim)
+                    ).astype(np.float32)}}
+
+    loss = None
+    for i in range(args.steps):
+        loss = trainer.train_step(trainer.put_batch(batch()))
+        if (i + 1) % 10 == 0:
+            print(f"step {i + 1}: loss {float(loss):.4f}")
+    final_loss = float(loss)
+    print(f"final loss: {final_loss:.4f}")
+
+    # sampling consumes the STRUCTURED tree via get_params
+    engine = DiffusionSampler(
+        model_fn=apply_fn, schedule=CosineNoiseSchedule(timesteps=1000),
+        transform=EpsilonPredictionTransform(), sampler=DDIMSampler())
+    out = engine.generate_samples(
+        trainer.get_params(use_ema=False), num_samples=2, resolution=size,
+        diffusion_steps=4, rngstate=RngSeq.create(0))
+    assert np.isfinite(np.asarray(out)).all()
+    print(f"sampled {out.shape} via the unflattened tree")
+    return {"final_loss": final_loss}
+
+
+if __name__ == "__main__":
+    main()

@@ -68,3 +68,8 @@ def test_pipeline_parallel_example():
     hist = _run_example("09_pipeline_parallel.py")
     assert np.isfinite(hist["final_loss"])
     assert hist["drift"] < 1e-3
+
+
+def test_flat_params_bhld_example():
+    hist = _run_example("10_flat_params_bhld.py")
+    assert np.isfinite(hist["final_loss"])
