@@ -8,13 +8,15 @@ run on the same hardware: f32 activations, plain XLA attention, unfused
 GroupNorm+SiLU, and a blocking per-step loss readback — the execution
 semantics of the reference's single-chip train loop
 (reference flaxdiff/trainer/simple_trainer.py:526-542,
-general_diffusion_trainer.py:248-349). The actual reference package
-imports but its train step does not TRACE under the jax 0.9 in this
-image (tracer-sliced concatenate in its CFG splice,
-diffusion_trainer.py:190 — see scripts/bench_reference.py for the
-attempt + failure record; its README pins jax==0.4.28 and notes 0.4.30
-already broke it), so the baseline is this framework configured to the
-reference's execution semantics — stated honestly in `baseline_kind`.
+general_diffusion_trainer.py:248-349). TWO baselines exist: `ref`
+(those semantics re-created on this framework, `baseline_kind`) and
+`refreal` — the ACTUAL reference package's DiffusionTrainer/Unet on
+the same chip. The reference verbatim does not trace under this
+image's jax 0.9 (tracer-sliced concatenate in its CFG splice,
+diffusion_trainer.py:190; its README pins jax==0.4.28 and notes 0.4.30
+already broke it), so scripts/bench_reference.py retries with a
+documented 1-line in-memory compat patch (the where-mask splice its own
+newer trainer uses) — `vs_reference_binary` is reported from that run.
 
 Two MFU figures (VERDICT r2 weak #2):
   mfu_hw    — numerator from XLA cost analysis of the program that runs
@@ -512,6 +514,60 @@ def stage_ref(args) -> dict:
     return res
 
 
+def stage_refreal(args) -> dict:
+    """The ACTUAL reference package's train step on this chip.
+
+    scripts/bench_reference.py runs /root/reference's own
+    DiffusionTrainer/Unet (f32, NormalAttention, its CLI defaults) —
+    verbatim if it traces, else with a documented 1-line in-memory
+    jax-0.9 compat patch (its traced-slice CFG splice becomes the
+    where-mask its own newer trainer uses). This anchors vs_baseline on
+    the reference BINARY, not just reference execution semantics
+    (VERDICT r3 weak #8's asterisk).
+
+    This stage must NOT initialize a jax backend itself: the reference
+    subprocess needs the (single-lease) tunnel, and a parent holding it
+    would wedge the grandchild's init. Platform comes from the env the
+    orchestrator set at probe time."""
+    cpu = os.environ.get("JAX_PLATFORMS") == "cpu"
+    here = os.path.dirname(os.path.abspath(__file__))
+    cmd = [sys.executable, os.path.join(here, "scripts",
+                                        "bench_reference.py")]
+    if cpu:
+        # match stage_sweep's cpu-fallback workload (64px) so the
+        # vs_reference_binary ratio compares like with like
+        cmd += ["--image_size", "64", "--batch", "4", "--timed", "2"]
+    inner_timeout = 500 if cpu else 700   # under run_stage's est*2 cap
+    try:
+        # own process group: if this stage dies, the grandchild must
+        # not be orphaned holding the tunnel lease
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=inner_timeout,
+                              start_new_session=True)
+    except subprocess.TimeoutExpired as e:
+        err = (e.stderr.decode(errors="replace")
+               if isinstance(e.stderr, bytes) else (e.stderr or ""))
+        sys.stderr.write(err[-1500:])
+        raise SystemExit(f"refreal: reference run exceeded "
+                         f"{inner_timeout}s; killed")
+    sys.stderr.write(proc.stderr[-2000:])
+    out = {}
+    for line in proc.stdout.strip().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict):
+            out.update(rec)
+    out["platform"] = "cpu" if cpu else "tpu"
+    if "imgs_per_sec_per_chip" not in out:
+        # fail the stage so run_stage's retry logic applies (transient
+        # tunnel failures deserve the same retries as any other stage)
+        raise SystemExit(f"refreal: no result (rc {proc.returncode}): "
+                         f"{(out.get('error') or proc.stderr)[-200:]}")
+    return out
+
+
 def stage_ddim(args) -> dict:
     """50-step DDIM latency at 256^2 (BASELINE.md inference target).
 
@@ -865,20 +921,21 @@ def stage_longseq(args) -> dict:
 
 STAGES = {"flashtune": stage_flashtune, "sweep": stage_sweep,
           "sweep256": stage_sweep256, "ref": stage_ref,
+          "refreal": stage_refreal,
           "ddim": stage_ddim, "attnpad": stage_attnpad,
           "ablate": stage_ablate, "longseq": stage_longseq}
 
 # info-value order (VERDICT r3 next #1): the headline sweep first, its
 # baseline second; flashtune is cheap and unblocks the tuned micros;
 # ddim is the BASELINE.md inference target; the rest are diagnostics.
-STAGE_ORDER = ("sweep", "ref", "flashtune", "ddim", "attnpad",
-               "ablate", "sweep256", "longseq")
+STAGE_ORDER = ("sweep", "ref", "refreal", "flashtune", "ddim",
+               "attnpad", "ablate", "sweep256", "longseq")
 
 # rough healthy-tunnel cost estimates (seconds) for budget scheduling —
 # a stage is skipped when the remaining budget can't cover its MINIMUM
 # useful runtime (est/2), and its timeout is capped by what remains
-STAGE_EST = {"sweep": 900, "ref": 450, "flashtune": 150, "ddim": 600,
-             "attnpad": 90, "ablate": 900, "sweep256": 800,
+STAGE_EST = {"sweep": 900, "ref": 450, "refreal": 400, "flashtune": 150,
+             "ddim": 600, "attnpad": 90, "ablate": 900, "sweep256": 800,
              "longseq": 400}
 
 # stages that receive the flashtune winner env. Headline stages
@@ -1266,6 +1323,17 @@ def main():
                 result["vs_baseline_best"] = round(
                     result["value"] / ref["best_imgs_per_sec_per_chip"],
                     3)
+        rr = result["stages"].get("refreal", {})
+        if (rr.get("status") == "ok" and result["value"]
+                and rr.get("imgs_per_sec_per_chip")
+                # like-for-like only: the cpu fallback shrinks stages,
+                # and imgs/sec at different resolutions don't divide
+                and rr.get("image_size") ==
+                result["stages"].get("sweep", {}).get("image_size")):
+            # the strongest baseline: the reference BINARY on this chip
+            result["vs_reference_binary"] = round(
+                result["value"] / rr["imgs_per_sec_per_chip"], 3)
+            result["reference_binary_config"] = rr.get("config")
         ddim = result["stages"].get("ddim", {})
         if ddim.get("status") == "ok" and ddim.get("key"):
             result[ddim["key"]] = ddim.get("latency_ms")
