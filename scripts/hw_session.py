@@ -27,6 +27,7 @@ OUT = sys.argv[1] if len(sys.argv) > 1 else "r4_hw_session.jsonl"
 PLAN = [
     ("sweep", 2700),
     ("ref", 900),
+    ("refreal", 900),
     ("flashtune", 1200),
     ("ddim", 1500),
     ("attnpad", 900),
