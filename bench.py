@@ -103,7 +103,8 @@ def build_trainer(tpu_native: bool, image_size: int = IMAGE_SIZE,
                   flat_params: bool = False,
                   depths: tuple = (64, 128, 256, 512),
                   attn_levels: int = 2,
-                  remat: bool = False):
+                  remat: bool = False,
+                  ref_arch: bool = False):
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -128,13 +129,24 @@ def build_trainer(tpu_native: bool, image_size: int = IMAGE_SIZE,
     # reference binary purely from emulation overhead)
     import jax
     on_tpu = jax.devices()[0].platform == "tpu"
+    if ref_arch:
+        # the reference's CLI-default architecture (training.py:145,
+        # simple_unet.py:76): pure attention, dim_head = C/heads — the
+        # model-matched twin for vs_reference_binary_matched
+        configs = tuple(
+            None if i < len(depths) - attn_levels else
+            dict(attn, dim_head=depths[i] // attn["heads"],
+                 only_pure_attention=True)
+            for i in range(len(depths)))
+    else:
+        configs = tuple(
+            None if i < len(depths) - attn_levels else dict(attn)
+            for i in range(len(depths)))
     model = Unet(
         output_channels=3,
         emb_features=max(depths),
         feature_depths=tuple(depths),
-        attention_configs=tuple(
-            None if i < len(depths) - attn_levels else dict(attn)
-            for i in range(len(depths))),
+        attention_configs=configs,
         num_res_blocks=2,
         dtype=jnp.bfloat16 if (tpu_native and on_tpu) else None,
         remat=remat,
@@ -862,7 +874,12 @@ def stage_ablate(args) -> dict:
             # both optimizations at once — the expected next default if
             # each wins alone
             ("attn=flash,norm=pallas,opt=flatparams,layout=bhld",
-             dict(flat_params=True), {"FLAXDIFF_ATTN_BHLD": "1"})):
+             dict(flat_params=True), {"FLAXDIFF_ATTN_BHLD": "1"}),
+            # OUR framework running the reference's EXACT architecture
+            # (pure attention, dim_head=C/heads): divided by refreal's
+            # number this is "same model, switch framework" —
+            # vs_reference_binary_matched
+            ("arch=refmatch", dict(ref_arch=True), {})):
         try:
             for ek, ev in env_add.items():
                 os.environ[ek] = ev
@@ -1347,6 +1364,16 @@ def main():
             result["vs_reference_binary"] = round(
                 result["value"] / rr["imgs_per_sec_per_chip"], 3)
             result["reference_binary_config"] = rr.get("config")
+        ab = result["stages"].get("ablate", {})
+        match = (ab.get("configs", {}).get("arch=refmatch", {})
+                 if ab.get("status") == "ok" else {})
+        if (rr.get("status") == "ok" and rr.get("imgs_per_sec_per_chip")
+                and match.get("imgs_per_sec_per_chip")
+                and rr.get("batch") == ab.get("batch")):
+            # same architecture, both frameworks, same chip, same batch
+            result["vs_reference_binary_matched"] = round(
+                match["imgs_per_sec_per_chip"]
+                / rr["imgs_per_sec_per_chip"], 3)
         ddim = result["stages"].get("ddim", {})
         if ddim.get("status") == "ok" and ddim.get("key"):
             result[ddim["key"]] = ddim.get("latency_ms")
