@@ -526,6 +526,11 @@ def stage_ref(args) -> dict:
         res["best_imgs_per_sec_per_chip"] = \
             ok[best_b]["imgs_per_sec_per_chip"]
     src = head if head in ok else best_b   # documented-config headline
+    if src != head:
+        # the baseline_kind string promises batch 16; flag loudly when
+        # the published cell is a substitute
+        res["headline_batch_fallback"] = \
+            f"documented batch {head} failed; published batch {src}"
     res["imgs_per_sec_per_chip"] = ok[src]["imgs_per_sec_per_chip"]
     res["batch_per_chip"] = int(src)
     res["step_time_ms"] = ok[src]["step_time_ms"]
@@ -562,18 +567,25 @@ def stage_refreal(args) -> dict:
         # match stage_sweep's cpu-fallback workload (64px) so the
         # vs_reference_binary ratio compares like with like
         cmd += ["--image_size", "64", "--batch", "4", "--timed", "2"]
+    batch_env = os.environ.get("FLAXDIFF_BENCH_ABLATE_BATCH")
+    if batch_env and not cpu:
+        # measure at the sweep's headline batch so the arch=refmatch
+        # ablate cell divides like for like (vs_reference_binary_matched)
+        cmd += ["--batch", batch_env]
     inner_timeout = 500 if cpu else 700   # under run_stage's est*2 cap
     try:
-        # own process group: if this stage dies, the grandchild must
-        # not be orphaned holding the tunnel lease
+        # the reference child stays in THIS stage's process group: if the
+        # orchestrator kills the stage group, it dies too (no orphaned
+        # lease-holder)
         proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=inner_timeout,
-                              start_new_session=True)
+                              timeout=inner_timeout)
     except subprocess.TimeoutExpired as e:
         err = (e.stderr.decode(errors="replace")
                if isinstance(e.stderr, bytes) else (e.stderr or ""))
         sys.stderr.write(err[-1500:])
-        raise SystemExit(f"refreal: reference run exceeded "
+        # LEASE-KILL tells run_stage to apply the long kill cool-down
+        # before retrying (a killed client wedges the tunnel ~10-20 min)
+        raise SystemExit(f"refreal: LEASE-KILL reference run exceeded "
                          f"{inner_timeout}s; killed")
     sys.stderr.write(proc.stderr[-2000:])
     out = {}
@@ -890,11 +902,16 @@ def stage_ablate(args) -> dict:
             res["configs"][key] = {
                 "imgs_per_sec_per_chip": round(ips, 3),
                 "step_time_ms": round(step_time * 1e3, 2)}
-            del trainer
         except Exception as e:
             res["configs"][key] = {
                 "error": f"{type(e).__name__}: {e}"[:160]}
         finally:
+            # a failed config's state must not shrink the next cell's
+            # memory frontier
+            try:
+                del trainer
+            except UnboundLocalError:
+                pass
             for ek in env_add:
                 os.environ.pop(ek, None)
         log(f"ablate {key}: {res['configs'][key]}")
@@ -972,7 +989,7 @@ STAGE_EST = {"sweep": 900, "ref": 450, "refreal": 400, "flashtune": 150,
 # (sweep/ref/ddim/sweep256) run with code defaults: an unvalidated
 # winner must never be able to take down the headline number (the r4
 # mid-round session exported native_d to the sweep and lost it).
-TUNED_STAGES = ("attnpad", "ablate", "longseq")
+TUNED_STAGES = ("attnpad", "ablate", "longseq", "refreal")
 
 
 def export_winner_env(env: dict, stages: dict) -> dict:
@@ -1071,6 +1088,19 @@ def probe_backend(timeout_s: int, budget_s: int, env=None) -> dict:
 
 # the stage subprocess currently on the tunnel (for the SIGTERM handler)
 _ACTIVE_CHILD = [None]
+
+
+def _kill_group(child):
+    """Kill a stage child AND its descendants (they share a session via
+    start_new_session=True at spawn)."""
+    import signal as _sig
+    try:
+        os.killpg(child.pid, _sig.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            child.kill()
+        except Exception:
+            pass
 # monotonic time of the last killed child: a kill leaks its tunnel lease
 # for ~10-20 min (probe_backend rationale), so the orchestrator spaces
 # the NEXT launch — whether the kill ended in a salvage, an abandoned
@@ -1113,15 +1143,18 @@ def run_stage(name: str, args, env, timeout_s: int, retries: int,
             # the in-flight child: an orphaned stage keeps the tunnel
             # lease ~10-20 min past the orchestrator's death, wedging
             # the NEXT session's backend init.
+            # own process group (start_new_session): killing the stage
+            # must also kill its descendants (e.g. refreal's reference
+            # subprocess) or an orphan keeps the tunnel lease alive
             child = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                      stderr=subprocess.PIPE, text=True,
-                                     env=env)
+                                     env=env, start_new_session=True)
             _ACTIVE_CHILD[0] = child
             out_txt, err_txt = child.communicate(timeout=attempt_timeout)
             proc = subprocess.CompletedProcess(cmd, child.returncode,
                                                out_txt, err_txt)
         except subprocess.TimeoutExpired:
-            child.kill()
+            _kill_group(child)
             _LAST_KILL_AT[0] = time.monotonic()
             out_txt, err_txt = child.communicate()
             # salvage: stages print their result-so-far before starting
@@ -1161,6 +1194,11 @@ def run_stage(name: str, args, env, timeout_s: int, retries: int,
             return out
         last = (f"rc {proc.returncode}: "
                 f"{(proc.stderr or proc.stdout).strip()[-300:]}")
+        if "LEASE-KILL" in (proc.stderr or "") + (proc.stdout or ""):
+            # the stage killed a tunnel client itself; same cool-down
+            # as if we had killed it
+            killed_prev = True
+            _LAST_KILL_AT[0] = time.monotonic()
         log(f"stage {name}: {last}")
     return {"status": f"failed: {last}"}
 
@@ -1236,15 +1274,15 @@ def main():
 
     def _on_term(signum, frame):
         result["terminated"] = f"signal {signum}"
+        # the signal may land mid-print of a cumulative emit: start on a
+        # fresh line so the final JSON is parseable on its own
+        sys.stdout.write("\n")
         emit(result, partial=False)
         child = _ACTIVE_CHILD[0]
         if child is not None:
             # an orphaned stage child would keep the tunnel lease alive
             # ~10-20 min past our death, wedging the next session
-            try:
-                child.kill()
-            except Exception:
-                pass
+            _kill_group(child)
         os._exit(1)
 
     signal.signal(signal.SIGTERM, _on_term)
@@ -1369,7 +1407,7 @@ def main():
                  if ab.get("status") == "ok" else {})
         if (rr.get("status") == "ok" and rr.get("imgs_per_sec_per_chip")
                 and match.get("imgs_per_sec_per_chip")
-                and rr.get("batch") == ab.get("batch")):
+                and int(rr.get("batch", -1)) == int(ab.get("batch", -2))):
             # same architecture, both frameworks, same chip, same batch
             result["vs_reference_binary_matched"] = round(
                 match["imgs_per_sec_per_chip"]

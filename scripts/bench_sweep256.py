@@ -116,24 +116,29 @@ def main(argv=None):
                        ok_all[k]["imgs_per_sec_per_chip"])
         res["best"] = dict(ok_all[best_key], batch=best_key)
     if args.trace and ok_num:
-        from bench import build_trainer, make_batches
-        from flaxdiff_tpu.profiling import trace
-        best_b = max(ok_num,
-                     key=lambda k: ok_num[k]["imgs_per_sec_per_chip"])
-        trainer = build_trainer(tpu_native=True,
-                                image_size=args.image_size,
-                                depths=depths,
-                                attn_backend=args.attn_backend)
-        put = [trainer.put_batch(b)
-               for b in make_batches(best_b, args.image_size, n=2)]
-        for i in range(2):
-            loss = trainer.train_step(put[i % 2])
-        float(jax.device_get(loss))
-        with trace(args.trace):
-            for i in range(5):
+        try:
+            from bench import build_trainer, make_batches
+            from flaxdiff_tpu.profiling import trace
+            best_b = max(ok_num,
+                         key=lambda k: ok_num[k]["imgs_per_sec_per_chip"])
+            trainer = build_trainer(tpu_native=True,
+                                    image_size=args.image_size,
+                                    depths=depths,
+                                    attn_backend=args.attn_backend)
+            put = [trainer.put_batch(b)
+                   for b in make_batches(best_b, args.image_size, n=2)]
+            for i in range(2):
                 loss = trainer.train_step(put[i % 2])
             float(jax.device_get(loss))
-        res["trace_dir"] = args.trace
+            with trace(args.trace):
+                for i in range(5):
+                    loss = trainer.train_step(put[i % 2])
+                float(jax.device_get(loss))
+            res["trace_dir"] = args.trace
+        except Exception as e:
+            # the tunnel dying during the trace must not erase the
+            # measured per-batch cells below
+            res["trace_error"] = f"{type(e).__name__}: {e}"[:200]
     line = json.dumps(res)
     print(line, flush=True)
     if args.out:

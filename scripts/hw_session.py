@@ -39,7 +39,7 @@ PLAN = [
 # stages that run under the measured flashtune-winner env (bench.py
 # TUNED_STAGES rationale: an unvalidated winner must not be able to
 # take down a headline stage)
-TUNED = ("attnpad", "ablate", "longseq")
+TUNED = ("attnpad", "ablate", "longseq", "refreal")
 
 
 def emit(rec):
@@ -59,7 +59,9 @@ def main():
     emit({"session_start": PLAN})
     for name, timeout in PLAN:
         t0 = time.monotonic()
-        cmd = [sys.executable, "bench.py", "--stage", name]
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        cmd = [sys.executable, os.path.join(repo, "bench.py"),
+               "--stage", name]
         stage_env = dict(env)
         if name in TUNED:
             added = export_winner_env(stage_env, stages_done)
