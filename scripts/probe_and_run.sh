@@ -1,13 +1,18 @@
 #!/bin/bash
 # Patient tunnel prober: one long-timeout probe every ~15 min; on the
-# first healthy answer, run the full hardware bench session and exit.
-# Rationale in bench.py probe_backend: killed-mid-init clients leak a
-# server-side lease for ~10-20 min, so sparse patient probes beat churn
-# (r3 observed a 15-min-interval prober succeeding every time while
-# 120s-retry probing failed for an hour).
+# first healthy answer, run the budget-bounded bench orchestrator and
+# exit. Rationale in bench.py probe_backend: killed-mid-init clients
+# leak a server-side lease for ~10-20 min, so sparse patient probes beat
+# churn (r3 observed a 15-min-interval prober succeeding every time
+# while 120s-retry probing failed for an hour). The orchestrator's
+# --budget bounds the session so it cannot overrun into whatever owns
+# the tunnel next (e.g. the round-end driver bench).
 set -u
-OUT=${1:-r4_hw_session2.jsonl}
-DEADLINE=$(( $(date +%s) + ${2:-14400} ))   # default: give up after 4 h
+OUT=${1:-bench_session.out}
+DEADLINE=$(( $(date +%s) + ${2:-10800} ))   # default: give up after 3 h
+BUDGET=${3:-5400}
+
+cd "$(dirname "$0")/.."
 
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if timeout 560 python - <<'EOF'
@@ -15,8 +20,9 @@ import jax, sys
 sys.exit(0 if jax.devices()[0].platform == "tpu" else 1)
 EOF
   then
-    echo "$(date -u +%FT%TZ) tunnel healthy; starting session" >&2
-    exec python scripts/hw_session.py "$OUT"
+    echo "$(date -u +%FT%TZ) tunnel healthy; starting bench session" >&2
+    exec python bench.py --budget "$BUDGET" --probe_timeout 90 \
+        --probe_budget 120 --no_cpu_fallback >> "$OUT" 2>&1
   fi
   echo "$(date -u +%FT%TZ) tunnel still wedged; sleeping 900s" >&2
   sleep 900
