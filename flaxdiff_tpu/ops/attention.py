@@ -18,14 +18,27 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _flash_interpret() -> bool:
+    """FLAXDIFF_FLASH_INTERPRET=1 routes flash dispatch through the
+    Pallas interpreter on ANY platform — the debugging hook that runs
+    the real kernel code paths inside full models on CPU (with
+    ops.flash_attention._FORCE_LANES for the hardware lane layout)."""
+    import os
+    return os.environ.get("FLAXDIFF_FLASH_INTERPRET") == "1"
+
+
 @functools.cache
-def attention_backend_available(backend: str = "flash") -> bool:
-    if backend != "flash":
-        return True
+def _flash_on_tpu() -> bool:
     try:
         return jax.devices()[0].platform == "tpu"
     except Exception:
         return False
+
+
+def attention_backend_available(backend: str = "flash") -> bool:
+    if backend != "flash":
+        return True
+    return _flash_on_tpu() or _flash_interpret()
 
 
 def _xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -183,9 +196,11 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     force_fp32_for_softmax=force_fp32_for_softmax)
         q, k, v, pad = _maybe_pad_head_dim(q, k, v)
         if sharded is not None:
-            out = _shard_mapped_flash(q, k, v, scale_eff, mesh, *sharded)
+            out = _shard_mapped_flash(q, k, v, scale_eff, mesh, *sharded,
+                                      interpret=_flash_interpret())
         else:
-            out = flash_attention(q, k, v, scale=scale_eff)
+            out = flash_attention(q, k, v, scale=scale_eff,
+                                  interpret=_flash_interpret())
         return out[..., :d] if pad else out
     if backend == "flash" and not attention_backend_available("flash"):
         import warnings
@@ -278,6 +293,7 @@ def dot_product_attention_bhld(q: jax.Array, k: jax.Array, v: jax.Array,
     q3 = q.reshape(b * h, q.shape[2], q.shape[3])
     k3 = k.reshape(b * h, k.shape[2], k.shape[3])
     v3 = v.reshape(b * h, v.shape[2], v.shape[3])
-    out = flash_attention_bh(q3, k3, v3, scale=scale_eff)
+    out = flash_attention_bh(q3, k3, v3, scale=scale_eff,
+                             interpret=_flash_interpret())
     out = out.reshape(b, h, lq, out.shape[-1])
     return out[..., :d] if pad else out
