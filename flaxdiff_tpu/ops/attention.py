@@ -36,9 +36,25 @@ def _flash_on_tpu() -> bool:
 
 
 def attention_backend_available(backend: str = "flash") -> bool:
+    if backend == "prebuilt":
+        from .prebuilt_flash import prebuilt_available
+        return prebuilt_available()
     if backend != "flash":
         return True
     return _flash_on_tpu() or _flash_interpret()
+
+
+def _flash_impl() -> str:
+    """Which flash implementation backend="auto" uses on TPU:
+    "firstparty" (ops/flash_attention.py, default) or "prebuilt" (JAX's
+    tuned TPU kernel — the one the reference calls). The flashtune bench
+    stage measures both and RECORDS the winner (best["impl"]); routing
+    production runs to it is a deliberate operator choice via this env
+    var (the bench never exports it — see export_winner_env). Read at
+    trace time, so multi-host runs must set it identically on every
+    host."""
+    import os
+    return os.environ.get("FLAXDIFF_FLASH_IMPL", "firstparty")
 
 
 def _xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -173,6 +189,11 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             return ring_self_attention(
                 q, k, v, mesh, seq_axis=seq_axis, scale=scale)
         backend = "auto"
+    if backend == "prebuilt":
+        if _prebuilt_usable():
+            return _prebuilt_btnh(q, k, v, scale)
+        _warn_prebuilt_fallback()
+        backend = "xla"
     use_flash = False
     if backend in ("auto", "flash") and attention_backend_available("flash"):
         # Sequences shorter than one q block gain nothing from the kernel;
@@ -187,20 +208,21 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         # mesh fall back to partitionable XLA attention instead.
         from ..parallel.context import get_active_mesh
         mesh = get_active_mesh()
-        sharded = None
         if mesh is not None and mesh.devices.size > 1:
             sharded = _flash_specs(mesh, q.shape[0], q.shape[2])
             if sharded is None:
                 return _xla_attention(
                     q, k, v, scale=scale,
                     force_fp32_for_softmax=force_fp32_for_softmax)
-        q, k, v, pad = _maybe_pad_head_dim(q, k, v)
-        if sharded is not None:
+            q, k, v, pad = _maybe_pad_head_dim(q, k, v)
             out = _shard_mapped_flash(q, k, v, scale_eff, mesh, *sharded,
                                       interpret=_flash_interpret())
-        else:
-            out = flash_attention(q, k, v, scale=scale_eff,
-                                  interpret=_flash_interpret())
+            return out[..., :d] if pad else out
+        if _route_auto_to_prebuilt(backend):
+            return _prebuilt_btnh(q, k, v, scale)
+        q, k, v, pad = _maybe_pad_head_dim(q, k, v)
+        out = flash_attention(q, k, v, scale=scale_eff,
+                              interpret=_flash_interpret())
         return out[..., :d] if pad else out
     if backend == "flash" and not attention_backend_available("flash"):
         import warnings
@@ -208,6 +230,68 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       "falling back to XLA attention", stacklevel=2)
     return _xla_attention(q, k, v, scale=scale,
                           force_fp32_for_softmax=force_fp32_for_softmax)
+
+
+def _prebuilt_usable() -> bool:
+    """Prebuilt kernel is dispatchable here: kernel importable, a real
+    TPU backend, and NOT a >1-device mesh — like any pallas_call the
+    prebuilt kernel is opaque to GSPMD, and unlike the first-party path
+    it has no shard_map wrapper yet, so a multi-device mesh would
+    silently replicate the full global q/k/v per device."""
+    if not attention_backend_available("prebuilt"):
+        return False
+    from ..parallel.context import get_active_mesh
+    mesh = get_active_mesh()
+    return mesh is None or mesh.devices.size <= 1
+
+
+def _prebuilt_bhld(q, k, v, scale):
+    """Shared pad→prebuilt-kernel→slice sequence over [B,H,L,D]
+    operands — the single implementation behind every dispatch site so
+    the padding/scale policy cannot drift between them.
+
+    Unlike the first-party path, head_dim stays NATIVE when it is a
+    sublane multiple (the reference calls this kernel at d=64 unpadded —
+    reference flaxdiff/models/attention.py:100-102; 128-padding it here
+    would double its head-dim compute and bias every head-to-head
+    against it). Only a non-multiple-of-8 head_dim is padded up to the
+    next sublane multiple."""
+    from .prebuilt_flash import prebuilt_flash_attention_bhld
+    d = q.shape[-1]
+    scale_eff = scale if scale is not None else 1.0 / (d ** 0.5)
+    pad = (-d) % 8
+    if pad:
+        widths = ((0, 0),) * (q.ndim - 1) + ((0, pad),)
+        q, k, v = (jnp.pad(t, widths) for t in (q, k, v))
+    out = prebuilt_flash_attention_bhld(q, k, v, scale=scale_eff)
+    return out[..., :d] if pad else out
+
+
+def _prebuilt_btnh(q, k, v, scale):
+    """_prebuilt_bhld for [B,L,H,D] callers — the one place the layout
+    adaptation lives."""
+    out = _prebuilt_bhld(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), scale)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _route_auto_to_prebuilt(backend: str) -> bool:
+    """Single gating policy for routing backend="auto" to the prebuilt
+    kernel (shared by both layout dispatchers so they cannot diverge):
+    opted in via FLAXDIFF_FLASH_IMPL=prebuilt, not under the interpret
+    debugging hook (the prebuilt pallas_call exposes no interpret), and
+    dispatchable here (TPU, single-device mesh)."""
+    return (backend == "auto" and _flash_impl() == "prebuilt"
+            and not _flash_interpret() and _prebuilt_usable())
+
+
+def _warn_prebuilt_fallback():
+    import warnings
+    warnings.warn("backend='prebuilt' requested but the prebuilt TPU "
+                  "kernel is unavailable here (no TPU, or a >1-device "
+                  "mesh it cannot shard); falling back to XLA attention",
+                  stacklevel=3)
 
 
 def _maybe_pad_head_dim(q, k, v):
@@ -274,6 +358,14 @@ def dot_product_attention_bhld(q: jax.Array, k: jax.Array, v: jax.Array,
             force_fp32_for_softmax=force_fp32_for_softmax)
         return out.transpose(0, 2, 1, 3)
 
+    if backend == "prebuilt":
+        if _prebuilt_usable():
+            return _prebuilt_bhld(q, k, v, scale)
+        _warn_prebuilt_fallback()
+        return _xla_attention_bhld(
+            q, k, v, scale=scale,
+            force_fp32_for_softmax=force_fp32_for_softmax)
+
     use_flash = (backend in ("auto", "flash")
                  and attention_backend_available("flash")
                  and lq >= 128)
@@ -287,8 +379,11 @@ def dot_product_attention_bhld(q: jax.Array, k: jax.Array, v: jax.Array,
             q, k, v, scale=scale,
             force_fp32_for_softmax=force_fp32_for_softmax)
 
-    from .flash_attention import flash_attention_bh
     scale_eff = scale if scale is not None else 1.0 / (d ** 0.5)
+    if _route_auto_to_prebuilt(backend):
+        return _prebuilt_bhld(q, k, v, scale)
+
+    from .flash_attention import flash_attention_bh
     q, k, v, pad = _maybe_pad_head_dim(q, k, v)
     q3 = q.reshape(b * h, q.shape[2], q.shape[3])
     k3 = k.reshape(b * h, k.shape[2], k.shape[3])
