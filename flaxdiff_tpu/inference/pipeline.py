@@ -134,9 +134,12 @@ class DiffusionInferencePipeline:
                         step: Optional[int] = None,
                         autoencoder=None) -> "DiffusionInferencePipeline":
         """Load the config dict + state saved by the training CLI."""
-        cfg_path = os.path.join(checkpoint_dir, CONFIG_FILENAME)
-        with open(cfg_path) as f:
-            config = json.load(f)
+        # epath for every sidecar read so gs:// checkpoint dirs work the
+        # same as local ones (the shard restore already goes through
+        # orbax's own object-store layer)
+        from etils import epath
+        cfg_path = epath.Path(checkpoint_dir) / CONFIG_FILENAME
+        config = json.loads(cfg_path.read_text())
 
         from ..trainer.checkpoints import Checkpointer
         ckpt = Checkpointer(checkpoint_dir)
@@ -156,15 +159,15 @@ class DiffusionInferencePipeline:
         # the config flag is authoritative; the structural heuristic
         # covers checkpoints written before the flag existed
         if config.get("flat_params") or is_flat_params(params):
-            tmpl_path = os.path.join(checkpoint_dir, TEMPLATE_FILENAME)
-            if not os.path.exists(tmpl_path):
+            tmpl_path = epath.Path(checkpoint_dir) / TEMPLATE_FILENAME
+            if not tmpl_path.exists():
                 raise FileNotFoundError(
                     f"{checkpoint_dir} holds a flat-params checkpoint "
                     f"but no {TEMPLATE_FILENAME}; re-save from the "
                     "trainer (train.py writes it automatically) or "
                     "unflatten manually with trainer.optim")
-            with open(tmpl_path) as f:
-                template = deserialize_template(json.load(f))
+            template = deserialize_template(json.loads(
+                tmpl_path.read_text()))
             params = unflatten_params(template, params)
             if ema is not None and is_flat_params(ema):
                 ema = unflatten_params(template, ema)
@@ -219,6 +222,15 @@ class DiffusionInferencePipeline:
             num_samples = conditioning.shape[0]
             unconditional = self.input_config.get_unconditionals(
                 batch_size=num_samples)[0]
+        elif self.input_config is not None and self.input_config.conditions:
+            # prompt-less sampling from a CONDITIONAL checkpoint: feed
+            # the cached null-conditioning tokens (what uncond dropout
+            # trained on). Passing None instead would trace the model
+            # without its cross-attention branches and fail against the
+            # checkpointed param tree (the branch structure depends on
+            # whether context is present, e.g. Unet's mid block).
+            conditioning = self.input_config.get_unconditionals(
+                batch_size=num_samples)[0]
         ds = self.get_sampler(sampler, guidance_scale)
         out = ds.generate_samples(
             params=params, num_samples=num_samples, resolution=resolution,
@@ -230,7 +242,10 @@ class DiffusionInferencePipeline:
 
 
 def save_pipeline_config(checkpoint_dir: str, config: Dict[str, Any]):
-    """Write the config dict the pipeline rebuilds from."""
-    os.makedirs(checkpoint_dir, exist_ok=True)
-    with open(os.path.join(checkpoint_dir, CONFIG_FILENAME), "w") as f:
-        json.dump(config, f, indent=2)
+    """Write the config dict the pipeline rebuilds from (epath, so a
+    gs:// checkpoint dir gets its config beside the shards — the
+    from_checkpoint read side already goes through epath)."""
+    from etils import epath
+    d = epath.Path(checkpoint_dir)
+    d.mkdir(parents=True, exist_ok=True)
+    (d / CONFIG_FILENAME).write_text(json.dumps(config, indent=2))

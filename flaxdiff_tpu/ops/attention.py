@@ -95,6 +95,22 @@ def _flash_specs(mesh, n_batch: int, n_heads: int):
     return batch_axes, head_axis
 
 
+def _shard_map_compat(body, mesh, spec):
+    """shard_map with the jax-version compat policy in ONE place: the
+    import moved out of experimental, and the replication-check kwarg
+    was renamed check_rep -> check_vma (pallas_call primitives carry no
+    varying-axis info, so the check must be off either way)."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    kwargs = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    try:
+        return shard_map(body, check_vma=False, **kwargs)
+    except TypeError:
+        return shard_map(body, check_rep=False, **kwargs)
+
+
 def _shard_mapped_flash(q: jax.Array, k: jax.Array, v: jax.Array,
                         scale: float, mesh, batch_axes, head_axis,
                         interpret: bool = False) -> jax.Array:
@@ -107,11 +123,6 @@ def _shard_mapped_flash(q: jax.Array, k: jax.Array, v: jax.Array,
     and head sharding need no collectives (to_out's contraction over
     sharded heads gets its all-reduce from GSPMD outside the kernel).
     """
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
-
     from .flash_attention import flash_attention
 
     b_spec = (tuple(batch_axes) if len(batch_axes) > 1
@@ -119,13 +130,33 @@ def _shard_mapped_flash(q: jax.Array, k: jax.Array, v: jax.Array,
     spec = jax.sharding.PartitionSpec(b_spec, None, head_axis, None)
     body = lambda a, b, c: flash_attention(a, b, c, scale=scale,
                                            interpret=interpret)
-    kwargs = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    try:
-        # pallas_call primitives carry no varying-axis info; skip the check
-        fn = shard_map(body, check_vma=False, **kwargs)
-    except TypeError:
-        fn = shard_map(body, check_rep=False, **kwargs)
-    return fn(q, k, v)
+    return _shard_map_compat(body, mesh, spec)(q, k, v)
+
+
+def _shard_mapped_flash_bhld(q: jax.Array, k: jax.Array, v: jax.Array,
+                             scale: float, mesh, batch_axes, head_axis,
+                             interpret: bool = False) -> jax.Array:
+    """_shard_mapped_flash for [B, H, L, D] operands: batch axes shard
+    dim 0, the tensor axis shards heads on dim 1, and each device's
+    local [b/dp, h/tp, L, d] shard reshapes FREELY into the kernel's
+    [B*H, L, D] grid layout — multi-chip runs keep the transpose-free
+    path the BHLD projections exist for (ADVICE r4: routing every
+    multi-device mesh through the transposing BLHD dispatcher lost the
+    layout win exactly on the production configs)."""
+    from .flash_attention import flash_attention_bh
+
+    b_spec = (tuple(batch_axes) if len(batch_axes) > 1
+              else (batch_axes[0] if batch_axes else None))
+    spec = jax.sharding.PartitionSpec(b_spec, head_axis, None, None)
+
+    def body(ql, kl, vl):
+        bl, hl = ql.shape[0], ql.shape[1]
+        flat = lambda t: t.reshape(bl * hl, t.shape[2], t.shape[3])
+        out = flash_attention_bh(flat(ql), flat(kl), flat(vl),
+                                 scale=scale, interpret=interpret)
+        return out.reshape(bl, hl, out.shape[1], out.shape[2])
+
+    return _shard_map_compat(body, mesh, spec)(q, k, v)
 
 
 def _seq_parallel_gate(q: jax.Array, k: jax.Array,
@@ -342,9 +373,10 @@ def dot_product_attention_bhld(q: jax.Array, k: jax.Array, v: jax.Array,
     around the opaque pallas custom call (the r3 trace counted ~750
     layout-copy ops/step around `_to_bh`); a BHLD-projecting module
     (models/attention.py AttentionLayer bhld=True) avoids them
-    entirely. Sequence-parallel / performer / multi-device paths route
-    through the BLHD dispatcher (one transpose each way — they were
-    not the copy hotspot); single-device flash and XLA run natively."""
+    entirely. Sequence-parallel / performer paths route through the
+    BLHD dispatcher (one transpose each way — they were not the copy
+    hotspot); single-device flash/XLA and multi-device batch/head-
+    sharded flash (shard_map over the mesh) run natively."""
     assert q.ndim == 4 and k.ndim == 4 and v.ndim == 4
     b, h, lq, d = q.shape
 
@@ -352,6 +384,20 @@ def dot_product_attention_bhld(q: jax.Array, k: jax.Array, v: jax.Array,
     mesh = get_active_mesh()
     multi = mesh is not None and mesh.devices.size > 1
     if backend in ("ring", "ulysses", "performer") or multi:
+        # batch/head-sharded flash keeps the BHLD-native shard_map path
+        # (free reshapes into the kernel grid); everything else —
+        # sequence-parallel backends, shapes that don't tile the mesh —
+        # routes through the BLHD dispatcher (one transpose each way)
+        if (multi and backend in ("auto", "flash")
+                and attention_backend_available("flash") and lq >= 128):
+            sharded = _flash_specs(mesh, b, h)
+            if sharded is not None:
+                scale_eff = scale if scale is not None else 1.0 / (d ** 0.5)
+                q, k, v, pad = _maybe_pad_head_dim(q, k, v)
+                out = _shard_mapped_flash_bhld(
+                    q, k, v, scale_eff, mesh, *sharded,
+                    interpret=_flash_interpret())
+                return out[..., :d] if pad else out
         out = dot_product_attention(
             q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
             v.transpose(0, 2, 1, 3), backend=backend, scale=scale,

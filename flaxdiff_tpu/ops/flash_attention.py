@@ -17,8 +17,10 @@ first-party implementation covering the whole autodiff path. Design:
   accumulating dq over the kv dimension. dk/dv: grid (batch*heads,
   kv_blocks, q_blocks) accumulating over the q dimension. Both recompute
   probabilities blockwise from (q, k, lse) — O(N) memory, no stored probs.
-  The per-row correction term delta = rowsum(dO * O) is computed in-kernel
-  from the (full-head-dim) dO/O blocks, so no extra residual is stored.
+  The per-row correction term delta = rowsum(dO * O) is computed ONCE as
+  a fused XLA reduce before the kernels and streamed in lane-replicated
+  like lse (computing it in-kernel cost an O-block HBM stream + VPU
+  reduce per grid step in BOTH kernels).
 - kv-length masking via lane iota, so cross-attention (e.g. CLIP kv_len=77)
   works after padding to the lane-aligned block. Padded q rows are exact:
   zero-padded q gives finite lse, zero-padded dO zeroes their gradient
@@ -118,7 +120,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
 # Backward kernels
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref, dq_ref, dq_scr,
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, delta_ref, lse_ref,
+                   dq_ref, dq_scr,
                    *, scale: float, kv_len: int, block_k: int):
     ki = pl.program_id(2)
     num_kb = pl.num_programs(2)
@@ -131,8 +134,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref, dq_ref, dq_scr,
     k = k_ref[0]                                 # [block_k, d]
     v = v_ref[0]
     g = g_ref[0]                                 # [block_q, d]
-    o = o_ref[0]
     lse = lse_ref[0]                             # [block_q, LANES] f32
+    # delta = rowsum(dO*O), computed ONCE host-side and lane-replicated
+    # like lse — recomputing it per (qi, ki) grid step cost an extra
+    # [block_q, d] O-block HBM stream plus VPU work in BOTH backward
+    # kernels (VERDICT r4 #3: the duplicated s/p-side recompute)
+    delta = delta_ref[0]                         # [block_q, LANES] f32
 
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
@@ -142,9 +149,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref, dq_ref, dq_scr,
 
     dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=1, keepdims=True)             # [block_q, 1]
-    ds = p * (dp - delta) * scale
+    ds = p * (dp - _bcast(delta, block_k)) * scale
     dq_scr[...] += jax.lax.dot_general(ds.astype(k.dtype), k,
                                        (((1,), (0,)), ((), ())),
                                        preferred_element_type=jnp.float32)
@@ -154,7 +159,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref, dq_ref, dq_scr,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, delta_ref, lse_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
                     *, scale: float, kv_len: int, block_k: int):
     qi = pl.program_id(2)
@@ -170,8 +175,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref,
     k = k_ref[0]                                 # [block_k, d]
     v = v_ref[0]
     g = g_ref[0]                                 # [block_q, d]
-    o = o_ref[0]
     lse = lse_ref[0]                             # [block_q, LANES]
+    delta = delta_ref[0]                         # [block_q, LANES]
 
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
@@ -185,9 +190,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, o_ref, lse_ref,
                                        preferred_element_type=jnp.float32)
     dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=1, keepdims=True)
-    ds = p * (dp - delta) * scale
+    ds = p * (dp - _bcast(delta, block_k)) * scale
     dk_scr[...] += jax.lax.dot_general(ds.astype(q.dtype), q,
                                        (((0,), (0,)), ((), ())),
                                        preferred_element_type=jnp.float32)
@@ -324,12 +327,20 @@ def _bwd_impl(q3, k3, v3, out_bh, lse, g3, scale, block_q, block_k,
     lq_pad, lk_pad = qb.shape[1], kb.shape[1]
     lanes = lse.shape[-1]
 
+    # delta = rowsum(dO * O): one fused XLA elementwise-reduce over the
+    # whole [bh, lq, d] tensors, lane-replicated like lse, instead of a
+    # per-grid-step recompute inside both kernels (which also forced O
+    # through HBM once per (qi, ki) pair in each kernel).
+    delta = jnp.sum(gb.astype(jnp.float32) * ob.astype(jnp.float32),
+                    axis=-1, keepdims=True)            # [bh, lq_pad, 1]
+    delta = jnp.broadcast_to(delta, (bh, lq_pad, lanes))
+
     qkv_specs = [
         pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
         pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
         pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
         pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),       # dO
-        pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),       # O
+        pl.BlockSpec((1, bq, lanes), lambda bh, qi, ki: (bh, qi, 0)),   # delta
         pl.BlockSpec((1, bq, lanes), lambda bh, qi, ki: (bh, qi, 0)),   # lse
     ]
     dq = pl.pallas_call(
@@ -343,7 +354,7 @@ def _bwd_impl(q3, k3, v3, out_bh, lse, g3, scale, block_q, block_k,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qb, kb, vb, gb, ob, lse)
+    )(qb, kb, vb, gb, delta, lse)
 
     # dk/dv: swap the roles of the q and kv grid dimensions.
     kv_specs = [
@@ -351,7 +362,7 @@ def _bwd_impl(q3, k3, v3, out_bh, lse, g3, scale, block_q, block_k,
         pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
         pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
         pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),       # dO
-        pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),       # O
+        pl.BlockSpec((1, bq, lanes), lambda bh, ki, qi: (bh, qi, 0)),   # delta
         pl.BlockSpec((1, bq, lanes), lambda bh, ki, qi: (bh, qi, 0)),   # lse
     ]
     dk, dv = pl.pallas_call(
@@ -374,7 +385,7 @@ def _bwd_impl(q3, k3, v3, out_bh, lse, g3, scale, block_q, block_k,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qb, kb, vb, gb, ob, lse)
+    )(qb, kb, vb, gb, delta, lse)
 
     return dq[:, :lq], dk[:, :kv_len], dv[:, :kv_len]
 

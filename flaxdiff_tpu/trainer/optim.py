@@ -61,6 +61,16 @@ def _unflatten(template: PyTree, flats: dict) -> PyTree:
     treedef = jax.tree_util.tree_structure(template)
     out = [None] * len(leaves)
     for name, idxs in _dtype_groups(leaves).items():
+        if name not in flats:
+            # e.g. an fp32 EMA over bf16 params restored against the
+            # params-derived template — fail with the mismatch spelled
+            # out instead of an opaque KeyError
+            raise KeyError(
+                f"flat state holds dtype groups {sorted(flats)} but the "
+                f"template expects {sorted(_dtype_groups(leaves))}; the "
+                "template's dtypes must match the flat tree it "
+                "unflattens (was this template derived from a tree "
+                "stored in a different dtype policy?)")
         vec = flats[name]
         pos = 0
         for i in idxs:
@@ -114,14 +124,35 @@ def serialize_template(template: PyTree) -> list:
     """JSON-able [(keypath, shape, dtype)] of a param template —
     persisted next to a flat-params checkpoint so inference can
     unflatten it without rebuilding the model at the training
-    resolution (some architectures' param shapes depend on it)."""
+    resolution (some architectures' param shapes depend on it).
+
+    Supports nested STRING-KEYED DICT trees only (the flax params
+    layout) and raises otherwise: "/"-joined keypaths cannot represent
+    list/tuple nodes or slash-containing keys round-trippably, and
+    deserialize_template + unflatten_params slice the flat vector by
+    leaf order — a silently re-ordered template would load wrong
+    weights at inference restore."""
     import jax
 
-    return [["/".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                      for p in path),
-             list(leaf.shape), jnp.dtype(leaf.dtype).name]
-            for path, leaf in
-            jax.tree_util.tree_flatten_with_path(template)[0]]
+    entries = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(template)[0]:
+        parts = []
+        for p in path:
+            key = getattr(p, "key", None)
+            if not isinstance(key, str):
+                raise TypeError(
+                    "flat-params template must be a nested string-keyed "
+                    f"dict tree; got path element {p!r} "
+                    f"({type(p).__name__}) — list/tuple/dataclass nodes "
+                    "are not round-trippable through the JSON template")
+            if "/" in key:
+                raise ValueError(
+                    f"template key {key!r} contains '/', which collides "
+                    "with the keypath separator")
+            parts.append(key)
+        entries.append(["/".join(parts), list(leaf.shape),
+                        jnp.dtype(leaf.dtype).name])
+    return entries
 
 
 def deserialize_template(entries: list) -> PyTree:

@@ -164,17 +164,20 @@ class DiffusionTrainer:
 
     def _write_param_template(self):
         import json as _json
-        import os as _os
 
         from .optim import TEMPLATE_FILENAME, serialize_template
         if jax.process_index() != 0:
             return
-        path = _os.path.join(self.checkpointer.directory,
-                             TEMPLATE_FILENAME)
+        # epath, not builtin open: the checkpointer itself writes through
+        # it, so object-store directories (gs://...) that hold a valid
+        # flat checkpoint get a readable template beside it instead of a
+        # local-only warn + guaranteed inference FileNotFoundError
+        from etils import epath
+        path = epath.Path(self.checkpointer.directory) / TEMPLATE_FILENAME
         try:
-            with open(path, "w") as f:
-                _json.dump(serialize_template(self._param_template), f)
-        except OSError as e:   # e.g. object-store path without fsspec
+            path.write_text(
+                _json.dumps(serialize_template(self._param_template)))
+        except OSError as e:
             import warnings
             warnings.warn(f"could not write {path}: {e}; flat-params "
                           "checkpoints need it for inference restore",

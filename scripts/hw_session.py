@@ -20,20 +20,24 @@ import subprocess
 import sys
 import time
 
-OUT = sys.argv[1] if len(sys.argv) > 1 else "r4_hw_session.jsonl"
+OUT = sys.argv[1] if len(sys.argv) > 1 else "r5_hw_session.jsonl"
 
-# (stage, timeout_s) in information-value order: headline sweep first
-# so a mid-session wedge still leaves it; tuned micros after flashtune.
+# (stage, timeout_s) in information-value order (VERDICT r4 next-round
+# list): the 128-sq sweep first (the one number comparable to r3's
+# 189.2 imgs/s / 0.227 MFU), then flashtune (cheap; prebuilt h2h +
+# winner for the tuned stages), then the in-context ablation, then the
+# 256-sq north star + batched ddim, then longseq; the ref baselines
+# last — they are stable context, not new information.
 PLAN = [
     ("sweep", 2700),
+    ("flashtune", 1500),
+    ("ablate", 2700),
+    ("sweep256", 2700),
+    ("ddim", 1500),
+    ("longseq", 1200),
     ("ref", 900),
     ("refreal", 900),
-    ("flashtune", 1200),
-    ("ddim", 1500),
     ("attnpad", 900),
-    ("ablate", 2400),
-    ("sweep256", 2700),
-    ("longseq", 1200),
 ]
 
 # stages that run under the measured flashtune-winner env (bench.py

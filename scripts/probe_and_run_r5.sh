@@ -1,0 +1,25 @@
+#!/bin/bash
+# r5 patient prober: long-timeout probe every 15 min; on the first
+# healthy answer run the FULL hardware session (scripts/hw_session.py,
+# info-value stage order) instead of the budget-bounded driver bench.
+# Rationale for the cadence: killed-mid-init clients leak a server-side
+# lease for ~10-20 min, so sparse patient probes beat churn.
+set -u
+OUT=${1:-r5_hw_session.jsonl}
+DEADLINE=$(( $(date +%s) + ${2:-36000} ))   # default: give up after 10 h
+
+cd "$(dirname "$0")/.."
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if timeout 560 python - <<'PYEOF'
+import jax, sys
+sys.exit(0 if jax.devices()[0].platform == "tpu" else 1)
+PYEOF
+  then
+    echo "$(date -u +%FT%TZ) tunnel healthy; starting hw session" >&2
+    exec python scripts/hw_session.py "$OUT" >> hw_session_r5.out 2>&1
+  fi
+  echo "$(date -u +%FT%TZ) tunnel still wedged; sleeping 900s" >&2
+  sleep 900
+done
+echo "$(date -u +%FT%TZ) gave up waiting for the tunnel" >&2

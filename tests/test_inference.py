@@ -130,3 +130,32 @@ def test_pipeline_from_registry(tmp_path):
     import pytest
     with pytest.raises(FileNotFoundError, match="no best run"):
         DiffusionInferencePipeline.from_registry(reg_path, metric="fid")
+
+
+def test_promptless_sampling_from_conditional_checkpoint(tmp_path):
+    """A CONDITIONAL checkpoint sampled without prompts must condition on
+    the cached null tokens, not trace the model context-free: the param
+    tree's branch structure depends on context presence (Unet's mid
+    block forces use_self_and_cross=False, so attn1 is cross-attention
+    when context exists) and a context-free trace fails param loading."""
+    from train import main
+    ckpt_dir = str(tmp_path / "run")
+    main([
+        "--dataset", "synthetic", "--image_size", "8",
+        "--batch_size", "8", "--architecture", "unet",
+        "--model_config", json.dumps({
+            "emb_features": 16, "feature_depths": [8, 12],
+            "num_res_blocks": 1, "norm_groups": 4,
+            "attention_configs": [None, {"heads": 2, "dim_head": 4}]}),
+        "--dtype", "fp32",
+        "--total_steps", "2", "--warmup_steps", "1",
+        "--save_every", "2", "--log_every", "2",
+        "--text_encoder", "hash",
+        "--checkpoint_dir", ckpt_dir,
+    ])
+    pipe = DiffusionInferencePipeline.from_checkpoint(ckpt_dir)
+    out = pipe.generate_samples(num_samples=2, resolution=8,
+                                diffusion_steps=2, sampler="ddim",
+                                use_ema=False)
+    assert out.shape == (2, 8, 8, 3)
+    assert np.all(np.isfinite(out))

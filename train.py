@@ -81,6 +81,14 @@ def parse_args(argv=None):
                         "AND flat grads via AD (supersedes "
                         "--flat_optimizer; elementwise optimizers only; "
                         "changes checkpoint layout)")
+    p.add_argument("--attn_bhld", action="store_true",
+                   help="project attention q/k/v straight into the "
+                        "flash kernel's [B,H,L,D] layout (no per-op "
+                        "transposes). Sets FLAXDIFF_ATTN_BHLD for the "
+                        "whole process, so in multi-host runs every "
+                        "host resolves the same layout from the same "
+                        "command line (an env var set by hand on only "
+                        "some hosts would compile divergent programs)")
     p.add_argument("--grad_accum", type=int, default=1,
                    help=">1 accumulates gradients over k micro-batches "
                         "per optimizer update (optax.MultiSteps)")
@@ -249,6 +257,8 @@ def main(argv=None):
                    if autoencoder else args.image_size)
 
     # model
+    if args.attn_bhld:
+        os.environ["FLAXDIFF_ATTN_BHLD"] = "1"
     model_kwargs = json.loads(args.model_config)
     model_kwargs.setdefault("dtype", args.dtype)
     if autoencoder is not None:
