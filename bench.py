@@ -267,7 +267,8 @@ def _sweep_body(image_size: int, depths: tuple,
                 sync_every_step=False, timed_steps=timed)
         except Exception as e:
             err = f"{type(e).__name__}: {e}"
-            per_batch[key] = {"error": err[:300], "remat": remat}
+            per_batch[key] = {"error": err[:300], "remat": remat,
+                              "traceback": traceback.format_exc()[-600:]}
             log(f"batch {key}: FAILED {err[:200]}")
             if _backend_died(e):
                 # abort the sweep but KEEP the measured cells — the
@@ -502,7 +503,8 @@ def stage_ref(args) -> dict:
             log(f"reference-style batch {batch}: {ips:.2f} imgs/sec/chip")
         except Exception as e:
             per_batch[str(batch)] = {
-                "error": f"{type(e).__name__}: {e}"[:240]}
+                "error": f"{type(e).__name__}: {e}",
+                "traceback": traceback.format_exc()[-600:]}
             log(f"reference-style batch {batch}: FAILED {e}"[:200])
             aborted = (f"backend died at batch {batch}"
                        if _backend_died(e) else None)
@@ -566,8 +568,10 @@ def stage_refreal(args) -> dict:
                                         "bench_reference.py")]
     if cpu:
         # match stage_sweep's cpu-fallback workload (64px) so the
-        # vs_reference_binary ratio compares like with like
-        cmd += ["--image_size", "64", "--batch", "4", "--timed", "2"]
+        # vs_reference_binary ratio compares like with like; 3 timed
+        # steps = the SAME window as the matched twin below (unequal
+        # windows would add asymmetric warm-cache bias to the ratio)
+        cmd += ["--image_size", "64", "--batch", "4", "--timed", "3"]
     batch_env = os.environ.get("FLAXDIFF_BENCH_ABLATE_BATCH")
     if batch_env and not cpu:
         # measure at the sweep's headline batch so the arch=refmatch
@@ -603,6 +607,26 @@ def stage_refreal(args) -> dict:
         # tunnel failures deserve the same retries as any other stage)
         raise SystemExit(f"refreal: no result (rc {proc.returncode}): "
                          f"{(out.get('error') or proc.stderr)[-200:]}")
+    if cpu:
+        # matched-architecture twin INLINE on the cpu fallback (the
+        # ablate stage that provides arch=refmatch on TPU is
+        # TPU-gated): same arch, same batch, same platform — otherwise
+        # the fallback's vs_reference_binary compares our heavier
+        # flagship (cross-attn + GEGLU, fixed dim_head 64) against the
+        # reference's lighter pure-attention default and reads as a
+        # framework regression (VERDICT r4 weak #4 / next #5). Backend
+        # init here is safe: no tunnel on the cpu path.
+        try:
+            _apply_jax_platforms()
+            t = build_trainer(tpu_native=True, ref_arch=True,
+                              image_size=64)
+            ips, _st, _ = run(t, make_batches(4, 64), 4,
+                              sync_every_step=False, timed_steps=3)
+            out["ours_refmatch_imgs_per_sec_per_chip"] = round(ips, 3)
+            out["vs_reference_binary_matched"] = round(
+                ips / out["imgs_per_sec_per_chip"], 3)
+        except Exception:
+            out["ours_refmatch_error"] = traceback.format_exc()[-400:]
     return out
 
 
@@ -689,7 +713,7 @@ def stage_ddim(args) -> dict:
             log(f"ddim batch8: {dt * 1e3:.1f} ms "
                 f"({bt / dt:.2f} imgs/s)")
         except Exception as e:
-            res["batch8_error"] = f"{type(e).__name__}: {e}"[:160]
+            res["batch8_error"] = traceback.format_exc()[-400:]
     return res
 
 
@@ -727,7 +751,7 @@ def stage_attnpad(args) -> dict:
             chained_grad_ms("flash", q, k, v), 3)
     except Exception as e:
         res["flash_native_d64_ms"] = None
-        res["flash_native_error"] = f"{type(e).__name__}: {e}"
+        res["flash_native_error"] = traceback.format_exc()[-400:]
     finally:
         os.environ.pop("FLAXDIFF_FLASH_NATIVE_D", None)
     log(f"attnpad: {res}")
@@ -925,7 +949,8 @@ def stage_ablate(args) -> dict:
                 del trainer
             except Exception as e:
                 res["configs"][key] = {
-                    "error": f"{type(e).__name__}: {e}"[:160]}
+                    "error": f"{type(e).__name__}: {e}",
+                    "traceback": traceback.format_exc()[-600:]}
             log(f"ablate {key}: {res['configs'][key]}")
             print(json.dumps(res), flush=True)   # salvage point
     os.environ.pop("FLAXDIFF_FUSED_NORM", None)
@@ -986,7 +1011,8 @@ def stage_ablate(args) -> dict:
                 "step_time_ms": round(step_time * 1e3, 2)}
         except Exception as e:
             res["configs"][key] = {
-                "error": f"{type(e).__name__}: {e}"[:160]}
+                "error": f"{type(e).__name__}: {e}",
+                    "traceback": traceback.format_exc()[-600:]}
         finally:
             # a failed config's state must not shrink the next cell's
             # memory frontier
@@ -1039,8 +1065,7 @@ def stage_longseq(args) -> dict:
                     chained_grad_ms(backend, q, k, v, iters=10), 3)
             except Exception as e:
                 entry[f"{backend}_ms"] = None
-                entry[f"{backend}_error"] = \
-                    f"{type(e).__name__}: {e}"[:160]
+                entry[f"{backend}_error"] = traceback.format_exc()[-400:]
         res["lengths"][str(L)] = entry
         log(f"longseq L={L}: {entry}")
         if entry.get("flash_ms") is None:
@@ -1499,6 +1524,10 @@ def main():
             result["vs_reference_binary_matched"] = round(
                 match["imgs_per_sec_per_chip"]
                 / rr["imgs_per_sec_per_chip"], 3)
+        elif rr.get("vs_reference_binary_matched"):
+            # cpu fallback: refreal measured the matched twin inline
+            result["vs_reference_binary_matched"] = \
+                rr["vs_reference_binary_matched"]
         ddim = result["stages"].get("ddim", {})
         if ddim.get("status") == "ok" and ddim.get("key"):
             result[ddim["key"]] = ddim.get("latency_ms")

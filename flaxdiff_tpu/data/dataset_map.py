@@ -12,7 +12,8 @@ import numpy as np
 
 from .sources.av import AudioVideoAugmenter, AVSyncSource
 from .sources.base import MediaDataset
-from .sources.images import HFImageSource, ImageAugmenter, MemoryImageSource
+from .sources.images import (HFImageSource, ImageAugmenter,
+                             MemoryImageSource, TFDSImageSource)
 from .sources.videos import VideoClipAugmenter, VideoFolderSource
 
 DATASET_REGISTRY: Dict[str, Callable[..., MediaDataset]] = {}
@@ -44,6 +45,21 @@ def _synthetic(n: int = 256, image_size: int = 64, seed: int = 0,
     return MediaDataset(source=MemoryImageSource(images=imgs, labels=labels),
                         augmenter=ImageAugmenter(image_size=image_size),
                         media_type="image")
+
+
+@register_dataset("oxford_flowers102_tfds")
+def _flowers_tfds(image_size: int = 64, split: str = "train",
+                  data_dir: str | None = None, **kwargs) -> MediaDataset:
+    """Oxford Flowers via TFDS — the reference's exact canonical path
+    (reference flaxdiff/data/dataset_map.py:19-30, sources/images.py:
+    100-128). Gated on tensorflow_datasets being installed; the
+    'oxford_flowers102' HF entry covers the same data otherwise."""
+    return MediaDataset(
+        source=TFDSImageSource("oxford_flowers102", split=split,
+                               data_dir=data_dir),
+        augmenter=ImageAugmenter(image_size=image_size,
+                                 caption_from_class=True),
+        media_type="image")
 
 
 @register_dataset("oxford_flowers102")

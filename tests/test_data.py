@@ -203,3 +203,22 @@ def test_online_loader_lazy_process_shard():
     v = _SliceView(Big(), start=1, step=4)
     assert len(v) == 3
     assert [v[i] for i in range(len(v))] == [10, 50, 90]
+
+
+def test_tfds_source_registered_and_gated():
+    """The TFDS adapter (reference's canonical flowers path) registers
+    and either loads (tfds installed) or fails with the actionable
+    fallback message — never an opaque ImportError at registry time."""
+    import pytest
+
+    from flaxdiff_tpu.data.dataset_map import DATASET_REGISTRY, get_dataset
+    assert "oxford_flowers102_tfds" in DATASET_REGISTRY
+    ds = get_dataset("oxford_flowers102_tfds", image_size=16)
+    try:
+        import tensorflow_datasets  # noqa: F401
+        has_tfds = True
+    except ImportError:
+        has_tfds = False
+    if not has_tfds:
+        with pytest.raises(RuntimeError, match="HFImageSource"):
+            ds.source.get_source()
