@@ -1051,6 +1051,33 @@ def stage_longseq(args) -> dict:
 
     H, D = 8, 64
     res = {"platform": "tpu", "heads": H, "head_dim": D, "lengths": {}}
+    # On-chip correctness FIRST (VERDICT r4 next #6: 16k correctness was
+    # CPU-oracle/interpret-only): flash fwd at 16k tokens vs the XLA
+    # oracle at the same shape, f32 inputs so the comparison measures
+    # the kernel, not bf16 rounding. 16k XLA fwd-only fits (the [L,L]
+    # f32 score slice is 1 GiB streamed, unlike fwd+bwd which also
+    # stores probs for the backward).
+    try:
+        from flaxdiff_tpu.ops.attention import (_xla_attention,
+                                                dot_product_attention)
+        Lc = 16384
+        qc = jax.random.normal(jax.random.PRNGKey(7), (1, Lc, 2, D),
+                               jnp.float32)
+        kc = jax.random.normal(jax.random.PRNGKey(8), (1, Lc, 2, D),
+                               jnp.float32)
+        vc = jax.random.normal(jax.random.PRNGKey(9), (1, Lc, 2, D),
+                               jnp.float32)
+        got = jax.jit(lambda a, b, c: dot_product_attention(
+            a, b, c, backend="flash"))(qc, kc, vc)
+        want = jax.jit(_xla_attention)(qc, kc, vc)
+        err = float(jnp.max(jnp.abs(got.astype(jnp.float32)
+                                    - want.astype(jnp.float32))))
+        res["correctness_16k"] = {"max_abs_err_vs_xla": err,
+                                  "ok": bool(err < 5e-4)}
+        del qc, kc, vc, got, want
+        log(f"longseq 16k correctness vs xla: {res['correctness_16k']}")
+    except Exception:
+        res["correctness_16k"] = {"error": traceback.format_exc()[-400:]}
     for L in (8192, 16384, 32768):
         q = jax.random.normal(jax.random.PRNGKey(0), (1, L, H, D),
                               jnp.bfloat16)
