@@ -11,8 +11,11 @@ of any spatial size stream through VMEM in blocks:
   across the lane dim, and the mask matmul rides the MXU).
 - normalize kernel: (x - mean) * rstd * scale + bias (+ SiLU) per block.
 
-Backward recomputes through the XLA path (correct gradients; dedicated
-backward kernel is a later optimization). Falls back to XLA off-TPU.
+Backward (r5): dedicated Pallas kernels reusing the forward's saved
+per-group stats — one stats pass over (x, g) producing the dx correction
+terms and dscale/dbias partials, an O(B*G + C) XLA finalize, then the dx
+pass (FLAXDIFF_FUSED_NORM_BWD=xla restores the recompute-through-XLA
+backward for A/B). Falls back to XLA off-TPU.
 """
 from __future__ import annotations
 
@@ -82,6 +85,150 @@ def _gn_norm_kernel(x_ref, mean_ref, rstd_ref, scale_ref, bias_ref, o_ref, *,
     o_ref[0] = out.astype(o_ref.dtype)
 
 
+def _bwd_dy(x, g, mean, rstd, scale, bias, apply_silu: bool):
+    """(xhat, dy, dxhat) from loaded f32 blocks — the ONE copy of the
+    normalize + SiLU-derivative recompute shared by both backward
+    kernels (they must stay byte-identical or the stats pass and the
+    dx pass silently disagree)."""
+    xhat = (x - mean) * rstd
+    if apply_silu:
+        y = xhat * scale + bias
+        sig = jax.nn.sigmoid(y)
+        dy = g * sig * (1.0 + y * (1.0 - sig))
+    else:
+        dy = g
+    return xhat, dy, dy * scale
+
+
+def _gn_bwd_stats_kernel(x_ref, g_ref, mean_ref, rstd_ref, scale_ref,
+                         bias_ref, gsums_ref, csums_ref, *,
+                         groups: int, hw: int, block_hw: int,
+                         apply_silu: bool):
+    """Per-(sample, hw-block) backward partials in one read of (x, g):
+    group sums of (dxhat, dxhat*xhat) for the dx correction terms and
+    per-channel sums of (dy, dy*xhat) for dbias/dscale."""
+    i = pl.program_id(1)
+    x = x_ref[0].astype(jnp.float32)             # [block_hw, C]
+    g = g_ref[0].astype(jnp.float32)
+    c = x.shape[1]
+    valid = (i * block_hw
+             + jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)) < hw
+    x = jnp.where(valid, x, 0.0)
+    g = jnp.where(valid, g, 0.0)
+
+    mean = mean_ref[0].astype(jnp.float32)       # [1, C]
+    rstd = rstd_ref[0].astype(jnp.float32)
+    scale = scale_ref[...].astype(jnp.float32)
+    bias = bias_ref[...].astype(jnp.float32)
+
+    xhat, dy, dxhat = _bwd_dy(x, g, mean, rstd, scale, bias, apply_silu)
+
+    dot = functools.partial(jax.lax.dot_general,
+                            preferred_element_type=jnp.float32,
+                            precision=jax.lax.Precision.HIGHEST)
+    member = _member_mask(c, groups)
+    s1_c = jnp.sum(dxhat, axis=0, keepdims=True)           # [1, C]
+    s2_c = jnp.sum(dxhat * xhat, axis=0, keepdims=True)    # [1, C]
+    gsums_ref[0, 0] = jnp.concatenate(
+        [dot(s1_c, member, (((1,), (0,)), ((), ()))),
+         dot(s2_c, member, (((1,), (0,)), ((), ())))], axis=0)   # [2, G]
+    csums_ref[0, 0] = jnp.concatenate(
+        [jnp.sum(dy, axis=0, keepdims=True),
+         jnp.sum(dy * xhat, axis=0, keepdims=True)], axis=0)     # [2, C]
+
+
+def _gn_bwd_dx_kernel(x_ref, g_ref, mean_ref, rstd_ref, scale_ref,
+                      bias_ref, s1_ref, s2_ref, dx_ref, *,
+                      apply_silu: bool):
+    """dx = rstd * (dxhat - mean_S(dxhat) - xhat * mean_S(dxhat*xhat))
+    per block; the mean_S terms arrive per-channel-broadcast from the
+    XLA finalize."""
+    x = x_ref[0].astype(jnp.float32)
+    g = g_ref[0].astype(jnp.float32)
+    mean = mean_ref[0].astype(jnp.float32)
+    rstd = rstd_ref[0].astype(jnp.float32)
+    scale = scale_ref[...].astype(jnp.float32)
+    bias = bias_ref[...].astype(jnp.float32)
+
+    xhat, _dy, dxhat = _bwd_dy(x, g, mean, rstd, scale, bias, apply_silu)
+    dx = rstd * (dxhat - s1_ref[0].astype(jnp.float32)
+                 - xhat * s2_ref[0].astype(jnp.float32))
+    dx_ref[0] = dx.astype(dx_ref.dtype)
+
+
+def _pallas_gn_silu_bwd(x, scale, bias, mean_c, rstd_c, g, groups,
+                        apply_silu, interpret):
+    """Dedicated Pallas backward (VERDICT r4 #3): two tiled passes over
+    (x, g) — partial sums, XLA finalize (O(B*G + C)), then dx — instead
+    of re-running the whole forward chain through XLA autodiff. Returns
+    (dx, dscale, dbias)."""
+    orig_shape = x.shape
+    b, c = x.shape[0], x.shape[-1]
+    xr = x.reshape(b, -1, c)
+    gr = g.reshape(b, -1, c)
+    hw = xr.shape[1]
+    # half the forward's block rows: these kernels stream TWO block-size
+    # inputs (x and g) plus the xhat/y/sigmoid/dy temporaries, so the
+    # forward's sizing would roughly double live VMEM
+    blk = max(8, (_block_hw(hw, c) // 2) // 8 * 8)
+    blk = min(blk, max(8, (hw // 8) * 8)) if hw >= 8 else 8
+    nblk = pl.cdiv(hw, blk)
+    cg = c // groups
+
+    gsums, csums = pl.pallas_call(
+        functools.partial(_gn_bwd_stats_kernel, groups=groups, hw=hw,
+                          block_hw=blk, apply_silu=apply_silu),
+        grid=(b, nblk),
+        in_specs=[
+            pl.BlockSpec((1, blk, c), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, blk, c), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, 1, c), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, c), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, c), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, c), lambda i, j: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, 2, groups), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, 2, c), lambda i, j: (i, j, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, nblk, 2, groups), jnp.float32),
+            jax.ShapeDtypeStruct((b, nblk, 2, c), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xr, gr, mean_c, rstd_c, scale.reshape(1, c), bias.reshape(1, c))
+
+    # XLA finalize: merge blocks, normalize the group means, broadcast
+    # back to per-channel [B, 1, C] for the dx pass.
+    n = float(hw * cg)
+    s1_g = jnp.sum(gsums[:, :, 0], axis=1) / n        # [B, G]
+    s2_g = jnp.sum(gsums[:, :, 1], axis=1) / n
+    s1_c = jnp.repeat(s1_g, cg, axis=-1)[:, None, :]  # [B, 1, C]
+    s2_c = jnp.repeat(s2_g, cg, axis=-1)[:, None, :]
+    dbias = jnp.sum(csums[:, :, 0], axis=(0, 1)).astype(bias.dtype)
+    dscale = jnp.sum(csums[:, :, 1], axis=(0, 1)).astype(scale.dtype)
+
+    dx = pl.pallas_call(
+        functools.partial(_gn_bwd_dx_kernel, apply_silu=apply_silu),
+        grid=(b, nblk),
+        in_specs=[
+            pl.BlockSpec((1, blk, c), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, blk, c), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, 1, c), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, c), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, c), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, c), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, 1, c), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, c), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, blk, c), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, hw, c), x.dtype),
+        interpret=interpret,
+    )(xr, gr, mean_c, rstd_c, scale.reshape(1, c), bias.reshape(1, c),
+      s1_c, s2_c)
+    return dx.reshape(orig_shape), dscale, dbias
+
+
 def _xla_groupnorm_silu(x, scale, bias, groups, eps, apply_silu):
     b = x.shape[0]
     c = x.shape[-1]
@@ -95,9 +242,12 @@ def _xla_groupnorm_silu(x, scale, bias, groups, eps, apply_silu):
     return out.astype(x.dtype)
 
 
-def _impl(x: jax.Array, scale: jax.Array, bias: jax.Array,
-          groups: int, eps: float, apply_silu: bool,
-          interpret: bool, force_pallas: bool) -> jax.Array:
+def _impl_stats(x: jax.Array, scale: jax.Array, bias: jax.Array,
+                groups: int, eps: float, apply_silu: bool,
+                interpret: bool, force_pallas: bool):
+    """(out, mean_c, rstd_c) — stats are None on the XLA fallback paths
+    (their backward recomputes through XLA autodiff; the Pallas
+    backward needs the saved stats)."""
     c = x.shape[-1]
     assert c % groups == 0, f"channels {c} not divisible by groups {groups}"
     orig_shape = x.shape
@@ -105,13 +255,15 @@ def _impl(x: jax.Array, scale: jax.Array, bias: jax.Array,
 
     on_tpu = jax.devices()[0].platform == "tpu"
     if not force_pallas and not (on_tpu or interpret):
-        return _xla_groupnorm_silu(x, scale, bias, groups, eps, apply_silu)
+        return (_xla_groupnorm_silu(x, scale, bias, groups, eps,
+                                    apply_silu), None, None)
     if not force_pallas and os.environ.get("FLAXDIFF_FUSED_NORM") == "xla":
         # A/B escape hatch: the r3 trace showed ~750 layout copies/step
         # around the pallas custom calls — the bench's ablate stage uses
         # this to measure whether the fused kernel pays for its copies
         # in-context on real hardware
-        return _xla_groupnorm_silu(x, scale, bias, groups, eps, apply_silu)
+        return (_xla_groupnorm_silu(x, scale, bias, groups, eps,
+                                    apply_silu), None, None)
 
     xr = x.reshape(b, -1, c)
     hw = xr.shape[1]
@@ -162,7 +314,14 @@ def _impl(x: jax.Array, scale: jax.Array, bias: jax.Array,
         out_shape=jax.ShapeDtypeStruct((b, hw, c), x.dtype),
         interpret=interpret,
     )(xr, mean_c, rstd_c, scale.reshape(1, c), bias.reshape(1, c))
-    return out.reshape(orig_shape)
+    return out.reshape(orig_shape), mean_c, rstd_c
+
+
+def _impl(x: jax.Array, scale: jax.Array, bias: jax.Array,
+          groups: int, eps: float, apply_silu: bool,
+          interpret: bool, force_pallas: bool) -> jax.Array:
+    return _impl_stats(x, scale, bias, groups, eps, apply_silu,
+                       interpret, force_pallas)[0]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -173,21 +332,23 @@ def _fused_gn_silu(x, scale, bias, groups, eps, apply_silu, interpret,
 
 
 def _gn_fwd(x, scale, bias, groups, eps, apply_silu, interpret, force_pallas):
-    out = _impl(x, scale, bias, groups, eps, apply_silu, interpret,
-                force_pallas)
-    return out, (x, scale, bias)
+    out, mean_c, rstd_c = _impl_stats(x, scale, bias, groups, eps,
+                                      apply_silu, interpret, force_pallas)
+    return out, (x, scale, bias, mean_c, rstd_c)
 
 
 def _gn_bwd(groups, eps, apply_silu, interpret, force_pallas, res, g):
-    # Backward recomputes through the XLA reference path. Unlike
-    # attention (whose naive backward materializes an O(N^2) probability
-    # matrix — flash_attention now has dedicated Pallas dq/dk/dv kernels),
-    # GroupNorm's backward is a bandwidth-bound elementwise chain over the
-    # same O(N*C) activations the forward reads: recompute adds no
-    # asymptotic memory, and XLA fuses it into the surrounding backward
-    # elementwise ops. A dedicated kernel would save at most one re-read
-    # of x — not worth the maintenance until profiling says otherwise.
-    x, scale, bias = res
+    # Pallas-path backward: dedicated tiled kernels reusing the saved
+    # per-group stats (VERDICT r4 #3) — two passes over (x, g) instead
+    # of XLA re-deriving the whole forward chain (which recomputes the
+    # statistics reduction as well). FLAXDIFF_FUSED_NORM_BWD=xla is the
+    # A/B escape hatch mirroring FLAXDIFF_FUSED_NORM. XLA-path forwards
+    # (no saved stats) keep the recompute-through-autodiff backward.
+    x, scale, bias, mean_c, rstd_c = res
+    if (mean_c is not None
+            and os.environ.get("FLAXDIFF_FUSED_NORM_BWD") != "xla"):
+        return _pallas_gn_silu_bwd(x, scale, bias, mean_c, rstd_c, g,
+                                   groups, apply_silu, interpret)
     _, vjp = jax.vjp(
         lambda x_, s_, b_: _xla_groupnorm_silu(x_, s_, b_, groups, eps,
                                                apply_silu), x, scale, bias)
