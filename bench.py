@@ -1119,9 +1119,14 @@ STAGE_ORDER = ("sweep", "ref", "refreal", "flashtune", "ddim",
 # rough healthy-tunnel cost estimates (seconds) for budget scheduling —
 # a stage is skipped when the remaining budget can't cover its MINIMUM
 # useful runtime (est/2), and its timeout is capped by what remains
-STAGE_EST = {"sweep": 900, "ref": 450, "refreal": 400, "flashtune": 150,
+# refreal covers the reference subprocess (<=500s inner cap on cpu)
+# PLUS the inline matched-architecture twin on the cpu fallback, so its
+# est*2 window must fit both
+# flashtune covers the block ladder PLUS the r5 prebuilt head-to-head
+# (4 shapes x 2 impls, each a fresh compile)
+STAGE_EST = {"sweep": 900, "ref": 450, "refreal": 700, "flashtune": 500,
              "ddim": 600, "attnpad": 90, "ablate": 900, "sweep256": 800,
-             "longseq": 400}
+             "longseq": 550}   # + r5 on-chip 16k correctness cell
 
 # stages that receive the flashtune winner env. Headline stages
 # (sweep/ref/ddim/sweep256) run with code defaults: an unvalidated
