@@ -100,6 +100,22 @@ def main():
                "result": out, "stderr_tail": proc.stderr[-1500:]}
         emit(rec)
         stages_done[name] = out
+        if name == "sweep" and out.get("trace_dir"):
+            # attribute the step budget while the evidence is fresh —
+            # the r3 tuning came from exactly this breakdown, and a
+            # later wedge must not leave the trace unanalyzed
+            try:
+                ap = subprocess.run(
+                    [sys.executable,
+                     os.path.join(repo, "scripts", "analyze_trace.py"),
+                     out["trace_dir"], "--steps", "5"],
+                    capture_output=True, text=True, timeout=300)
+                emit({"stage": "sweep_trace_analysis",
+                      "breakdown": ap.stdout[-3000:],
+                      "stderr_tail": ap.stderr[-400:]})
+            except Exception as e:
+                emit({"stage": "sweep_trace_analysis",
+                      "status": f"failed: {e}"})
     emit({"session_end": True})
 
 
