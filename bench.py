@@ -1125,7 +1125,7 @@ STAGE_ORDER = ("sweep", "ref", "refreal", "flashtune", "ddim",
 # flashtune covers the block ladder PLUS the r5 prebuilt head-to-head
 # (4 shapes x 2 impls, each a fresh compile)
 STAGE_EST = {"sweep": 900, "ref": 450, "refreal": 700, "flashtune": 500,
-             "ddim": 600, "attnpad": 90, "ablate": 900, "sweep256": 800,
+             "ddim": 600, "attnpad": 90, "ablate": 1100, "sweep256": 800,
              "longseq": 550}   # + r5 on-chip 16k correctness cell
 
 # stages that receive the flashtune winner env. Headline stages
