@@ -95,3 +95,34 @@ def test_auto_impl_env_does_not_break_cpu():
                                    atol=1e-5, rtol=1e-5)
     finally:
         os.environ.pop("FLAXDIFF_FLASH_IMPL", None)
+
+
+def test_prebuilt_wrapper_block_clamp_and_bf16(monkeypatch):
+    """Blocks larger than the padded sequence must clamp (env asks for
+    512x1024 against a 128-token sequence) and bf16 operands must run
+    the kernel's native dtype path."""
+    b, h, l, d = 1, 2, 128, 64
+    q = _rand((b, h, l, d), 10).astype(jnp.bfloat16)
+    monkeypatch.setenv("FLAXDIFF_PREBUILT_BLOCK_Q", "512")
+    monkeypatch.setenv("FLAXDIFF_PREBUILT_BLOCK_K", "1024")
+    with pltpu.force_tpu_interpret_mode():
+        out = prebuilt_flash_attention_bhld(q, q, q)
+    ref = _xla_attention_bhld(q.astype(jnp.float32), q.astype(jnp.float32),
+                              q.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                               np.asarray(ref), atol=2e-2, rtol=2e-2)
+
+
+def test_prebuilt_dispatch_pads_odd_head_dim():
+    """head_dim not a sublane multiple (e.g. 20) is padded to the next
+    multiple of 8 by _prebuilt_bhld and sliced back — exactness comes
+    from zero-padded dims contributing nothing to logits or outputs."""
+    from flaxdiff_tpu.ops.attention import _prebuilt_bhld
+    b, h, l, d = 1, 1, 128, 20
+    q = _rand((b, h, l, d), 11)
+    with pltpu.force_tpu_interpret_mode():
+        out = _prebuilt_bhld(q, q, q, None)
+    assert out.shape == (b, h, l, d)
+    ref = _xla_attention_bhld(q, q, q)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
