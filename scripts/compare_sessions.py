@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Compare an hw-session jsonl (r5) against the standing r3-midround
+numbers: throughput/MFU movement, kernel head-to-head, ablation deltas.
+
+Usage:
+    python scripts/compare_sessions.py [r5_hw_session.jsonl]
+
+Prints a table the round report can lift verbatim; exits nonzero when
+the session holds no usable TPU sweep (so automation can tell "nothing
+to compare" from "compared").
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+R3 = {"imgs_per_sec_per_chip": 189.2, "mfu_hw": 0.227, "mfu_model": 0.249,
+      "flash_ms_128x128": 30.581, "flash_ms_tuned": 5.434}
+
+
+def load_session(path: str) -> dict:
+    stages = {}
+    for line in open(path):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("status") == "ok" and "result" in rec:
+            stages[rec["stage"]] = rec["result"]
+        elif "stage" in rec and rec.get("status", "").startswith(
+                ("timeout", "rc", "no JSON")):
+            stages.setdefault("_failures", {})[rec["stage"]] = rec["status"]
+    return stages
+
+
+def fmt(x, nd=3):
+    return "—" if x is None else (f"{x:.{nd}f}"
+                                  if isinstance(x, float) else str(x))
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else "r5_hw_session.jsonl"
+    if not os.path.exists(path):
+        print(f"no session file at {path}")
+        return 1
+    st = load_session(path)
+    rows = []
+
+    sweep = st.get("sweep", {})
+    if sweep.get("platform") == "tpu":
+        ips = sweep.get("imgs_per_sec_per_chip")
+        rows.append(("sweep imgs/s/chip", R3["imgs_per_sec_per_chip"], ips,
+                     None if not ips else ips / R3["imgs_per_sec_per_chip"]))
+        for k in ("mfu_hw", "mfu_model"):
+            v = sweep.get(k)
+            rows.append((f"sweep {k}", R3[k], v,
+                         None if not v else v / R3[k]))
+
+    ft = st.get("flashtune", {})
+    best = ft.get("best") or {}
+    if best.get("ms"):
+        rows.append(("flash fwd+bwd ms (flagship)", R3["flash_ms_tuned"],
+                     best["ms"], R3["flash_ms_tuned"] / best["ms"]))
+    for shape, cell in (ft.get("head_to_head_ms") or {}).items():
+        r = cell.get("ratio_fp_over_pb")
+        if r is not None:
+            rows.append((f"h2h {shape} firstparty/prebuilt ms ratio",
+                         None, r, None))
+
+    ab = st.get("ablate", {})
+    cfgs = ab.get("configs") or {}
+    base = (cfgs.get("attn=flash,norm=pallas") or {}).get(
+        "imgs_per_sec_per_chip")
+    if base:
+        for key, cell in sorted(cfgs.items()):
+            v = cell.get("imgs_per_sec_per_chip")
+            if v and key != "attn=flash,norm=pallas":
+                rows.append((f"ablate {key} vs flash+pallas",
+                             base, v, v / base))
+
+    s256 = st.get("sweep256", {})
+    if s256.get("mfu_hw") is not None:
+        rows.append(("sweep256 mfu_hw (north star, target 0.40)",
+                     0.40, s256["mfu_hw"], s256["mfu_hw"] / 0.40))
+
+    dd = st.get("ddim", {})
+    if dd.get("latency_ms") and dd.get("key", "").startswith("ddim50"):
+        rows.append(("ddim50@256 batch-1 ms (r3: 1153)", 1153.0,
+                     dd["latency_ms"], 1153.0 / dd["latency_ms"]))
+        if dd.get("batch8_imgs_per_sec"):
+            rows.append(("ddim50@256 batch-8 imgs/s", None,
+                         dd["batch8_imgs_per_sec"], None))
+
+    ls = st.get("longseq", {})
+    c16 = ls.get("correctness_16k") or {}
+    if "ok" in c16:
+        rows.append(("longseq 16k on-chip correctness",
+                     None, f"ok={c16['ok']} err={fmt(c16.get('max_abs_err_vs_xla'), 6)}",
+                     None))
+
+    if not rows:
+        print(f"{path}: no TPU results to compare"
+              f" (failures: {st.get('_failures')})")
+        return 1
+    w = max(len(r[0]) for r in rows) + 2
+    print(f"{'metric':<{w}}{'baseline':>12}{'r5':>14}{'ratio':>8}")
+    for name, baseline, v, ratio in rows:
+        print(f"{name:<{w}}{fmt(baseline):>12}{fmt(v):>14}"
+              f"{fmt(ratio, 2):>8}")
+    if st.get("_failures"):
+        print("\nfailed stages:", st["_failures"])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
