@@ -242,3 +242,28 @@ def test_bhld_multidevice_shard_mapped_flash(monkeypatch):
     np.testing.assert_allclose(
         np.asarray(got3), np.asarray(_xla_attention_bhld(q3, q3, q3)),
         atol=2e-5, rtol=2e-5)
+
+
+def test_bhld_ring_backend_matches_xla():
+    """BHLD dispatcher + backend='ring' under a seq mesh: the
+    sequence-parallel route goes through the BLHD dispatcher (one
+    transpose each way) and must stay numerically exact."""
+    from flaxdiff_tpu.ops.attention import (_xla_attention_bhld,
+                                            dot_product_attention_bhld)
+    from flaxdiff_tpu.parallel import create_mesh, use_mesh
+
+    mesh = create_mesh(axes={"data": 2, "seq": 4})
+    rng = np.random.default_rng(11)
+    # [B, H, L, D]; L divisible by the seq axis, B by the data axis
+    q = jnp.asarray(rng.normal(size=(2, 2, 32, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 2, 32, 16)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 2, 32, 16)), jnp.float32)
+    want = _xla_attention_bhld(q, k, v)
+    with use_mesh(mesh):
+        got = dot_product_attention_bhld(q, k, v, backend="ring")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+    with use_mesh(mesh):
+        got_u = dot_product_attention_bhld(q, k, v, backend="ulysses")
+    np.testing.assert_allclose(np.asarray(got_u), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
