@@ -161,3 +161,77 @@ def pull_artifact(name: str, target_dir: str,
         return art.download(root=target_dir)
     except Exception:
         return None
+
+
+def compare_against_wandb_best(current_value: float,
+                               metric: str = "train/best_loss",
+                               top_k: int = 2,
+                               higher_is_better: bool = False,
+                               api: Any = None,
+                               entity: Optional[str] = None,
+                               project: Optional[str] = None,
+                               sweep_id: Optional[str] = None,
+                               filters: Optional[Dict[str, Any]] = None,
+                               exclude_run_id: Optional[str] = None):
+    """Compare a finishing run against the wandb project's (or sweep's)
+    historical best — the API variant of the local registry's top_k
+    (reference general_diffusion_trainer.py:596-703 semantics).
+
+    Ranks the fetched runs by `summary["best_<metric>"]` (project query)
+    or `summary[<metric>]` (sweep query, matching the reference's two
+    paths), direction-aware; takes the top-k slice's value bounds; and
+    returns (is_good, is_best, bounds, ranked_top_k) where is_good means
+    the current run lands inside the top-k bounds and is_best means it
+    beats them all.
+
+    `api` is injectable (duck-typed: `.runs(path=..., filters=...)` and
+    `.sweep(path).runs`, each run carrying `.id` and `.summary`), so the
+    logic is testable without network; None lazily builds `wandb.Api()`.
+    Returns (True, True, None, []) when there is no history to compare
+    against — a first run is trivially the best, as in the local
+    registry. Runs without a finite value for the metric (crashed runs
+    never wrote the summary key) are dropped before ranking — the
+    reference ranks them at ±inf, which blows out the bounds and makes
+    is_good vacuously true. Pass `exclude_run_id` with the finishing
+    run's own id: wandb syncs summaries live, so the run under
+    evaluation otherwise appears in its own history and a new project
+    best would compare against itself and report is_best=False.
+    """
+    import math
+    if api is None:
+        import wandb
+        api = wandb.Api()
+    if sweep_id is not None:
+        if filters is not None:
+            raise ValueError(
+                "filters only apply to the project query; the sweep API "
+                "exposes no server-side filtering — filter the sweep's "
+                "runs yourself or drop sweep_id")
+        runs = list(api.sweep(f"{entity}/{project}/{sweep_id}").runs)
+        key = metric
+    else:
+        runs = list(api.runs(path=f"{entity}/{project}", filters=filters))
+        key = f"best_{metric}"
+
+    def val(run):
+        v = run.summary.get(key)
+        return float(v) if isinstance(v, (int, float)) else float("nan")
+
+    runs = [r for r in runs
+            if math.isfinite(val(r))
+            and getattr(r, "id", None) != exclude_run_id]
+    runs = sorted(runs, key=val, reverse=higher_is_better)
+    top = runs[:top_k]
+    if not top:
+        return True, True, None, []
+    vals = [val(r) for r in top]
+    bounds = (min(vals), max(vals))
+    if higher_is_better:
+        is_good = current_value > bounds[0]
+        is_best = current_value > bounds[1]
+    else:
+        is_good = current_value < bounds[1]
+        is_best = current_value < bounds[0]
+    ranked = [{"run": getattr(r, "id", None), "value": val(r)}
+              for r in top]
+    return is_good, is_best, bounds, ranked

@@ -89,3 +89,116 @@ def test_registry_top_k_ranked(tmp_path):
     reg2 = ModelRegistry(str(tmp_path / "registry.json"))
     assert [r["run"] for r in reg2.top_k("loss")] == \
         ["run1", "run3", "run0", "run2"]
+
+
+def test_compare_against_wandb_best_fake_api():
+    """The wandb-API comparison (reference general_diffusion_trainer
+    596-703) with an injected fake client: direction-aware ranking,
+    top-k bounds, is_good/is_best, sweep vs project key selection."""
+    from flaxdiff_tpu.trainer.registry import compare_against_wandb_best
+
+    class Run:
+        def __init__(self, id, **summary):
+            self.id, self.summary = id, summary
+
+    class FakeApi:
+        def __init__(self, runs):
+            self._runs = runs
+            self.calls = []
+
+        def runs(self, path=None, filters=None):
+            self.calls.append(("runs", path, filters))
+            return self._runs
+
+        def sweep(self, path):
+            self.calls.append(("sweep", path))
+            api = self
+
+            class Sweep:
+                runs = api._runs
+            return Sweep()
+
+    # lower-is-better project query keys on best_<metric>
+    api = FakeApi([Run("a", **{"best_train/loss": 0.5}),
+                   Run("b", **{"best_train/loss": 0.3}),
+                   Run("c", **{"best_train/loss": 0.9})])
+    good, best, bounds, ranked = compare_against_wandb_best(
+        0.4, metric="train/loss", top_k=2, api=api,
+        entity="e", project="p")
+    assert (good, best) == (True, False)       # inside top-2, not best
+    assert bounds == (0.3, 0.5)
+    assert [r["run"] for r in ranked] == ["b", "a"]
+    assert api.calls[0][1] == "e/p"
+
+    good, best, _, _ = compare_against_wandb_best(
+        0.2, metric="train/loss", top_k=2, api=api,
+        entity="e", project="p")
+    assert (good, best) == (True, True)
+    good, best, _, _ = compare_against_wandb_best(
+        0.95, metric="train/loss", top_k=2, api=api,
+        entity="e", project="p")
+    assert (good, best) == (False, False)
+
+    # higher-is-better sweep query keys on the bare metric
+    api2 = FakeApi([Run("x", **{"val/clip": 0.8}),
+                    Run("y", **{"val/clip": 0.6})])
+    good, best, bounds, ranked = compare_against_wandb_best(
+        0.9, metric="val/clip", top_k=2, higher_is_better=True,
+        api=api2, entity="e", project="p", sweep_id="s1")
+    assert (good, best) == (True, True)
+    assert bounds == (0.6, 0.8)
+    assert api2.calls[0] == ("sweep", "e/p/s1")
+
+    # empty history: trivially best
+    good, best, bounds, ranked = compare_against_wandb_best(
+        1.0, api=FakeApi([]), entity="e", project="p")
+    assert (good, best, bounds, ranked) == (True, True, None, [])
+
+
+def test_compare_against_wandb_best_edge_cases():
+    """Non-finite/missing summary values are dropped (not ranked at
+    ±inf), the finishing run excludes itself, and sweep+filters raises."""
+    import pytest
+
+    from flaxdiff_tpu.trainer.registry import compare_against_wandb_best
+
+    class Run:
+        def __init__(self, id, **summary):
+            self.id, self.summary = id, summary
+
+    class FakeApi:
+        def __init__(self, runs):
+            self._runs = runs
+
+        def runs(self, path=None, filters=None):
+            return self._runs
+
+        def sweep(self, path):
+            api = self
+
+            class Sweep:
+                runs = api._runs
+            return Sweep()
+
+    # crashed run (no summary key) must not blow out the bounds
+    api = FakeApi([Run("ok", **{"best_train/loss": 0.5}), Run("crashed")])
+    good, best, bounds, ranked = compare_against_wandb_best(
+        100.0, metric="train/loss", top_k=2, api=api,
+        entity="e", project="p")
+    assert (good, best) == (False, False)
+    assert bounds == (0.5, 0.5)
+    assert [r["run"] for r in ranked] == ["ok"]
+
+    # a run that just set the project best must not compare against its
+    # own live-synced summary
+    api = FakeApi([Run("me", **{"best_train/loss": 0.1}),
+                   Run("other", **{"best_train/loss": 0.5})])
+    good, best, *_ = compare_against_wandb_best(
+        0.1, metric="train/loss", top_k=2, api=api,
+        entity="e", project="p", exclude_run_id="me")
+    assert (good, best) == (True, True)
+
+    with pytest.raises(ValueError, match="filters"):
+        compare_against_wandb_best(
+            0.1, api=FakeApi([]), entity="e", project="p",
+            sweep_id="s", filters={"state": "finished"})
