@@ -21,6 +21,10 @@ import sys
 import time
 
 OUT = sys.argv[1] if len(sys.argv) > 1 else "r5_hw_session.jsonl"
+# optional wall-clock deadline (unix epoch): a session that starts from
+# a LATE window must hand the tunnel back before the round-end driver
+# bench needs it — stages that no longer fit are skipped, not started
+DEADLINE = float(sys.argv[2]) if len(sys.argv) > 2 else None
 
 # (stage, timeout_s) in information-value order (VERDICT r4 next-round
 # list): the 128-sq sweep first (the one number comparable to r3's
@@ -60,8 +64,14 @@ def main():
 
     env = os.environ.copy()
     stages_done = {}
-    emit({"session_start": PLAN})
+    emit({"session_start": PLAN, "deadline": DEADLINE})
     for name, timeout in PLAN:
+        if DEADLINE is not None:
+            left = DEADLINE - time.time()
+            if left < 120:
+                emit({"stage": name, "status": "skipped: session deadline"})
+                continue
+            timeout = int(min(timeout, left - 60))
         t0 = time.monotonic()
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         cmd = [sys.executable, os.path.join(repo, "bench.py"),

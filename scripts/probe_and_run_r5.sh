@@ -17,7 +17,7 @@ sys.exit(0 if jax.devices()[0].platform == "tpu" else 1)
 PYEOF
   then
     echo "$(date -u +%FT%TZ) tunnel healthy; starting hw session" >&2
-    exec python scripts/hw_session.py "$OUT" >> hw_session_r5.out 2>&1
+    exec python scripts/hw_session.py "$OUT" 1785547800 >> hw_session_r5.out 2>&1
   fi
   echo "$(date -u +%FT%TZ) tunnel still wedged; sleeping 900s" >&2
   sleep 900
