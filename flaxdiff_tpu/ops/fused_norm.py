@@ -39,6 +39,15 @@ def _block_hw(hw: int, c: int) -> int:
     return max(8, (rows // 8) * 8)
 
 
+def _fused_norm_interpret() -> bool:
+    """FLAXDIFF_FUSED_NORM=interpret mirrors FLAXDIFF_FLASH_INTERPRET
+    (ops/attention.py _flash_interpret): run the real Pallas kernels —
+    fwd AND the r5 backward — through the interpreter inside full
+    models on CPU. One helper so fwd and bwd cannot read the env
+    differently (interpreted fwd + Mosaic bwd would crash)."""
+    return os.environ.get("FLAXDIFF_FUSED_NORM") == "interpret"
+
+
 def _member_mask(c: int, groups: int) -> jnp.ndarray:
     cg = c // groups
     ch = jax.lax.broadcasted_iota(jnp.int32, (c, groups), 0)
@@ -253,6 +262,8 @@ def _impl_stats(x: jax.Array, scale: jax.Array, bias: jax.Array,
     orig_shape = x.shape
     b = x.shape[0]
 
+    if _fused_norm_interpret():
+        interpret = True
     on_tpu = jax.devices()[0].platform == "tpu"
     if not force_pallas and not (on_tpu or interpret):
         return (_xla_groupnorm_silu(x, scale, bias, groups, eps,
@@ -347,6 +358,10 @@ def _gn_bwd(groups, eps, apply_silu, interpret, force_pallas, res, g):
     x, scale, bias, mean_c, rstd_c = res
     if (mean_c is not None
             and os.environ.get("FLAXDIFF_FUSED_NORM_BWD") != "xla"):
+        # the env interpret hook must reach the backward too — a fwd
+        # that ran interpreted would otherwise hand Mosaic a CPU build
+        if _fused_norm_interpret():
+            interpret = True
         return _pallas_gn_silu_bwd(x, scale, bias, mean_c, rstd_c, g,
                                    groups, apply_silu, interpret)
     _, vjp = jax.vjp(
