@@ -16,6 +16,9 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from ..resilience import events as _res_events
+from ..resilience import faults as _res_faults
+from ..resilience.retry import RetryPolicy
 from .dataloaders import collate, fallback_batch
 
 
@@ -86,20 +89,31 @@ def make_clip_similarity_filter(threshold: float = 0.25,
 
 
 def default_url_fetcher(timeout: float = 10.0,
-                        retries: int = 2) -> Callable[[str], bytes]:
-    """HTTP fetch with retries (reference online_loader.py:43-141)."""
+                        retries: int = 2,
+                        policy: Optional[RetryPolicy] = None,
+                        opener: Optional[Callable] = None
+                        ) -> Callable[[str], bytes]:
+    """HTTP fetch under the unified RetryPolicy (reference
+    online_loader.py:43-141 used a fixed 0.1 s sleep and retried
+    EVERYTHING — a dead URL (404/403) burned the full budget per record).
+
+    Exponential backoff + jitter between attempts; non-retryable HTTP
+    client errors (404, 403, ...) propagate after ONE attempt via the
+    policy's classifier. `policy` overrides the default (then `retries`
+    is ignored); `opener` substitutes urllib.request.urlopen in tests.
+    """
     import urllib.request
+    open_ = opener if opener is not None else urllib.request.urlopen
+    pol = policy if policy is not None else RetryPolicy(
+        max_attempts=retries + 1, base_delay=0.1, max_delay=2.0)
+
+    def attempt(url: str) -> bytes:
+        _res_faults.check("data.fetch")
+        with open_(url, timeout=timeout) as r:
+            return r.read()
 
     def fetch(url: str) -> bytes:
-        last: Optional[Exception] = None
-        for _ in range(retries + 1):
-            try:
-                with urllib.request.urlopen(url, timeout=timeout) as r:
-                    return r.read()
-            except Exception as e:  # noqa: BLE001 — retry any fetch error
-                last = e
-                time.sleep(0.1)
-        raise last
+        return pol.call(attempt, url, site="data.fetch")
 
     return fetch
 
@@ -137,7 +151,8 @@ class OnlineStreamingDataLoader:
                  filter_fn: Optional[Callable[[Dict[str, Any]], bool]] = None,
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None,
-                 seed: int = 0):
+                 seed: int = 0,
+                 starvation_action: str = "warn"):
         import jax
         pi = jax.process_index() if process_index is None else process_index
         pc = jax.process_count() if process_count is None else process_count
@@ -153,6 +168,15 @@ class OnlineStreamingDataLoader:
         self.queue: "queue.Queue" = queue.Queue(maxsize=queue_size)
         self.num_threads = num_threads
         self.seed = seed
+        if starvation_action not in ("warn", "raise"):
+            raise ValueError(
+                f"starvation_action must be 'warn' or 'raise', "
+                f"got {starvation_action!r}")
+        # "warn": starved rounds yield a zero fallback batch (reference
+        # dummy-injection semantics) and record a `starvation` event each
+        # time. "raise": fail fast — production runs must not silently
+        # train on filler batches.
+        self.starvation_action = starvation_action
         self._sampler = _EpochSampler(max(len(self.records), 1), seed)
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -214,6 +238,9 @@ class OnlineStreamingDataLoader:
 
     def _worker(self, worker_id: int):
         while not self._stop.is_set():
+            # chaos site: a plan arming "data.stall" wedges this worker
+            # for its configured delay (watchdog coverage)
+            _res_faults.maybe_stall("data.stall")
             try:
                 # record access is inside the fault barrier: lazy views
                 # (_SliceView over HF datasets) can raise on __getitem__
@@ -266,13 +293,31 @@ class OnlineStreamingDataLoader:
                 last_good = batch
                 yield batch
             elif last_good is not None:
-                # timeout: keep the training loop fed
-                # (reference online_loader.py:673-693 dummy injection)
+                # timeout: the pipeline is starving. Structured event
+                # either way; "raise" fails fast instead of silently
+                # training on filler, "warn" keeps the training loop fed
+                # with a zero fallback batch (reference
+                # online_loader.py:673-693 dummy injection).
+                _res_events.record_event(
+                    "starvation", "data.loader",
+                    detail=f"{len(samples)}/{self.batch_size} samples in "
+                           f"{self.timeout}s; "
+                           + ("yielding zero fallback batch"
+                              if self.starvation_action == "warn"
+                              else "failing fast"))
+                if self.starvation_action == "raise":
+                    raise RuntimeError(
+                        "online loader starved: "
+                        f"{len(samples)}/{self.batch_size} samples within "
+                        f"{self.timeout}s (starvation_action='raise')")
                 yield fallback_batch(last_good)
             else:
                 # Nothing ever produced: either the workers died or every
                 # record fails to decode — both are fatal, not a hang.
                 empty_rounds += 1
+                _res_events.record_event(
+                    "starvation", "data.loader",
+                    detail=f"no samples at all (round {empty_rounds})")
                 if (empty_rounds >= 3
                         or not any(t.is_alive() for t in self._threads)):
                     raise RuntimeError(

@@ -56,6 +56,12 @@ def prefetch_map(fn: Callable[[T], U], it: Iterator[T],
                 if not put(fn(item)):
                     return
         except BaseException as e:  # surfaced on the consumer side
+            # structured visibility BEFORE the re-raise lands: a consumer
+            # that swallows the exception (or dies with it) still leaves
+            # the pipeline failure in the resilience event stream
+            from ..resilience.events import record_event
+            record_event("pipeline_error", "data.prefetch",
+                         detail=f"{type(e).__name__}: {e}")
             put((_SENTINEL, e))
             return
         put((_SENTINEL, None))

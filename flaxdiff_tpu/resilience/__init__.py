@@ -1,0 +1,66 @@
+"""Resilience layer: deterministic fault injection, unified retry/
+backoff, checkpoint-integrity tooling, and a train-loop watchdog.
+
+At pod scale preemptions, flaky object stores, and wedged loaders are
+the steady state (ROADMAP north star; Pulse arXiv:2606.19163 treats
+elasticity as first-class). This package centralizes what used to be
+ad-hoc per-module handling:
+
+  events    structured resilience-event log (counters + subscribers),
+            surfaced through trainer/logging.py to JSONL/wandb/stdout
+  faults    seedable `FaultPlan` arming named sites (ckpt.save,
+            data.fetch, step.nan, ...) — chaos runs replay in pytest
+  retry     `RetryPolicy`: exponential backoff, jitter, deadline,
+            non-retryable classification
+  watchdog  heartbeat thread turning hangs into checkpoint-and-exit
+  verify    offline checkpoint-integrity checker (+ chaos corruption
+            helper); CLI in scripts/verify_checkpoint.py
+
+Dependency direction: trainer/ and data/ import resilience; resilience
+imports neither (verify's deep check lazily uses the Checkpointer).
+"""
+from .events import (
+    EventLog,
+    ResilienceEvent,
+    global_event_log,
+    record_event,
+    set_global_event_log,
+    use_event_log,
+)
+from .faults import (
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    InjectedHTTPError,
+    active_plan,
+    install_plan,
+)
+from .faults import check as fault_check
+from .faults import maybe_stall as fault_stall
+from .retry import RetryError, RetryPolicy, default_classifier
+from .verify import corrupt_step_dir, verify_checkpoint, verify_step
+from .watchdog import Watchdog
+
+__all__ = [
+    "EventLog",
+    "ResilienceEvent",
+    "global_event_log",
+    "set_global_event_log",
+    "use_event_log",
+    "record_event",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedFault",
+    "InjectedHTTPError",
+    "active_plan",
+    "install_plan",
+    "fault_check",
+    "fault_stall",
+    "RetryPolicy",
+    "RetryError",
+    "default_classifier",
+    "Watchdog",
+    "verify_checkpoint",
+    "verify_step",
+    "corrupt_step_dir",
+]

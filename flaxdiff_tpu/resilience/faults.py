@@ -1,0 +1,223 @@
+"""Deterministic fault injection: a seedable `FaultPlan` arms named
+sites to fail at chosen occurrence counts, so chaos runs replay exactly
+in pytest on CPU.
+
+Design: production code calls `check(SITE)` (or `maybe_stall`) at each
+fault barrier. With no plan installed that is one dict lookup on a
+module global — effectively free — so the sites stay compiled into the
+real code paths rather than living in test-only monkeypatches; the chaos
+suite exercises the SAME lines a pod failure would hit.
+
+Known sites (the framework's barriers; plans may name new ones freely):
+    ckpt.save     Checkpointer.save, inside the retry loop
+    ckpt.restore  Checkpointer.restore, per step attempted
+    data.fetch    default_url_fetcher / OnlineStreamingDataLoader._load_one
+    data.stall    loader worker: injects a sleep (wedged-loader chaos)
+    step.nan      DiffusionTrainer.fit: poisons the next loss readback
+    host.sigterm  DiffusionTrainer.fit: SIGTERMs the process at a step
+
+A plan is JSON-serializable and env-drivable::
+
+    plan = FaultPlan([FaultSpec("ckpt.save", at=(1,), error="io")], seed=0)
+    with plan.installed():
+        ...  # first Checkpointer.save attempt raises InjectedFault
+
+    FLAXDIFF_FAULT_PLAN='{"seed":0,"specs":[{"site":"data.fetch","prob":0.1}]}'
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Dict, Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .events import record_event
+
+ENV_VAR = "FLAXDIFF_FAULT_PLAN"
+
+
+class InjectedFault(OSError):
+    """An error raised by the fault-injection framework (subclasses
+    OSError so retry classifiers treat it as a transient I/O fault)."""
+
+
+class InjectedHTTPError(Exception):
+    """Stand-in for a non-retryable HTTP failure; carries `.code`."""
+
+    def __init__(self, code: int, msg: str = ""):
+        super().__init__(msg or f"injected HTTP {code}")
+        self.code = code
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One armed site.
+
+    at:    1-based occurrence indices at which the site fires (the Nth
+           time `check(site)` runs). Deterministic scheduling.
+    prob:  per-occurrence firing probability drawn from the plan's
+           seeded RNG (deterministic given the seed + call sequence).
+    times: max total firings for this spec (0 = unlimited).
+    error: "io" -> InjectedFault, "http404"/"http403"/... ->
+           InjectedHTTPError(code), "stall" -> no raise; `maybe_stall`
+           sleeps `delay` seconds, "flag" -> no raise; `check` returns
+           True (caller-interpreted, e.g. step.nan / host.sigterm).
+    delay: stall duration for error="stall".
+    """
+    site: str
+    at: Tuple[int, ...] = ()
+    prob: float = 0.0
+    times: int = 0
+    error: str = "io"
+    delay: float = 0.0
+
+    def as_dict(self) -> Dict[str, object]:
+        return {"site": self.site, "at": list(self.at), "prob": self.prob,
+                "times": self.times, "error": self.error, "delay": self.delay}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, object]) -> "FaultSpec":
+        return cls(site=str(d["site"]),
+                   at=tuple(int(x) for x in d.get("at", ())),
+                   prob=float(d.get("prob", 0.0)),
+                   times=int(d.get("times", 0)),
+                   error=str(d.get("error", "io")),
+                   delay=float(d.get("delay", 0.0)))
+
+
+class FaultPlan:
+    """Seedable, deterministic schedule of site failures."""
+
+    def __init__(self, specs: Sequence[FaultSpec] = (), seed: int = 0):
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._specs: Dict[str, list] = {}
+        for spec in specs:
+            self._specs.setdefault(spec.site, []).append(spec)
+        self._hits: Dict[str, int] = {}
+        self._fired: Dict[int, int] = {}    # id(spec) -> firings
+        self._rng = np.random.default_rng(seed)
+
+    # -- construction --------------------------------------------------------
+    def to_json(self) -> str:
+        specs = [s.as_dict() for ss in self._specs.values() for s in ss]
+        return json.dumps({"seed": self.seed, "specs": specs})
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        d = json.loads(text)
+        return cls([FaultSpec.from_dict(s) for s in d.get("specs", ())],
+                   seed=int(d.get("seed", 0)))
+
+    @classmethod
+    def from_env(cls, env: Optional[Dict[str, str]] = None
+                 ) -> Optional["FaultPlan"]:
+        text = (env if env is not None else os.environ).get(ENV_VAR)
+        return cls.from_json(text) if text else None
+
+    # -- firing logic --------------------------------------------------------
+    def hits(self, site: str) -> int:
+        with self._lock:
+            return self._hits.get(site, 0)
+
+    def _poll(self, site: str) -> Optional[FaultSpec]:
+        """Count one occurrence of `site`; return the spec that fires, if
+        any. Thread-safe and deterministic given the call sequence."""
+        with self._lock:
+            n = self._hits.get(site, 0) + 1
+            self._hits[site] = n
+            for spec in self._specs.get(site, ()):
+                if spec.times and self._fired.get(id(spec), 0) >= spec.times:
+                    continue
+                fire = n in spec.at
+                if not fire and spec.prob > 0:
+                    fire = bool(self._rng.random() < spec.prob)
+                if fire:
+                    self._fired[id(spec)] = self._fired.get(id(spec), 0) + 1
+                    return spec
+        return None
+
+    def check(self, site: str, step: Optional[int] = None) -> bool:
+        """One occurrence of `site`. Raises for error faults; returns
+        True for "flag" faults (caller decides what failing means);
+        False when nothing fires."""
+        spec = self._poll(site)
+        if spec is None:
+            return False
+        record_event("fault_injected", site,
+                     detail=f"error={spec.error} hit={self.hits(site)}",
+                     step=step)
+        if spec.error == "io":
+            raise InjectedFault(f"injected fault at {site} "
+                                f"(hit {self.hits(site)})")
+        if spec.error.startswith("http"):
+            raise InjectedHTTPError(int(spec.error[4:] or 500))
+        # "stall" polled via check() is a flag too: the sleep belongs in
+        # maybe_stall so exception sites never block.
+        return True
+
+    def maybe_stall(self, site: str, step: Optional[int] = None,
+                    sleep=time.sleep) -> float:
+        """One occurrence of a stall site; sleeps and returns the delay
+        (0.0 when nothing fires)."""
+        spec = self._poll(site)
+        if spec is None or spec.error != "stall":
+            return 0.0
+        record_event("fault_injected", site,
+                     detail=f"stall {spec.delay}s", step=step)
+        if spec.delay > 0:
+            sleep(spec.delay)
+        return spec.delay
+
+    # -- installation --------------------------------------------------------
+    @contextlib.contextmanager
+    def installed(self) -> Iterator["FaultPlan"]:
+        prev = install_plan(self)
+        try:
+            yield self
+        finally:
+            install_plan(prev)
+
+
+# Process-global active plan. None (the production default) short-circuits
+# every site check to a single `is None` test.
+_ACTIVE: Optional[FaultPlan] = None
+_active_lock = threading.Lock()
+_env_loaded = False
+
+
+def install_plan(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Install (or clear, with None) the active plan; returns previous."""
+    global _ACTIVE, _env_loaded
+    with _active_lock:
+        prev, _ACTIVE = _ACTIVE, plan
+        _env_loaded = True          # an explicit install wins over env
+    return prev
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The installed plan; lazily loads FLAXDIFF_FAULT_PLAN once."""
+    global _ACTIVE, _env_loaded
+    if not _env_loaded:
+        with _active_lock:
+            if not _env_loaded:
+                _env_loaded = True
+                if _ACTIVE is None:
+                    _ACTIVE = FaultPlan.from_env()
+    return _ACTIVE
+
+
+def check(site: str, step: Optional[int] = None) -> bool:
+    """Module-level site barrier: no-op without an active plan."""
+    plan = active_plan()
+    return plan.check(site, step=step) if plan is not None else False
+
+
+def maybe_stall(site: str, step: Optional[int] = None) -> float:
+    plan = active_plan()
+    return plan.maybe_stall(site, step=step) if plan is not None else 0.0

@@ -1,0 +1,151 @@
+"""Unified retry policy: exponential backoff + deterministic-seedable
+jitter + total-time deadline + non-retryable error classification.
+
+Replaces the bespoke loops that grew in the data layer (fixed
+`time.sleep(0.1)` in `default_url_fetcher`, which burned the full retry
+budget on HTTP 404s) and wraps `Checkpointer.save` / logger pushes, so
+every transient-fault path in the framework backs off the same way and
+reports through the same event stream.
+"""
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Callable, Optional, Tuple
+
+from .events import EventLog, global_event_log
+
+# HTTP statuses that will not succeed on retry (client errors minus 408
+# request-timeout and 429 too-many-requests, which are transient).
+NON_RETRYABLE_HTTP = frozenset(
+    {400, 401, 403, 404, 405, 406, 410, 411, 413, 414, 415, 422, 451})
+
+
+def _http_code(exc: BaseException) -> Optional[int]:
+    code = getattr(exc, "code", None)
+    if isinstance(code, int):
+        return code
+    resp = getattr(exc, "response", None)           # requests-style
+    return getattr(resp, "status_code", None) if resp is not None else None
+
+
+def default_classifier(exc: BaseException) -> bool:
+    """True if `exc` is worth retrying.
+
+    Retryable: I/O and network faults (OSError covers URLError, socket
+    timeouts, ConnectionError), plus HTTP 5xx/408/429. Non-retryable:
+    HTTP 4xx client errors, programming errors (TypeError/ValueError/
+    KeyError/AttributeError), and control-flow exceptions.
+    """
+    if isinstance(exc, (KeyboardInterrupt, SystemExit, GeneratorExit,
+                        StopIteration, AssertionError)):
+        return False
+    code = _http_code(exc)
+    if code is not None:
+        return code not in NON_RETRYABLE_HTTP
+    if isinstance(exc, (TypeError, ValueError, KeyError, AttributeError,
+                        IndexError, NotImplementedError)):
+        return False
+    return True
+
+
+class RetryError(RuntimeError):
+    """Raised when the budget is exhausted; chains the last failure."""
+
+    def __init__(self, site: str, attempts: int, last: BaseException):
+        super().__init__(
+            f"{site}: gave up after {attempts} attempt(s): {last!r}")
+        self.site = site
+        self.attempts = attempts
+        self.last = last
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with jitter and an optional wall-clock deadline.
+
+    Delay before attempt k (k >= 2) is
+    `min(base_delay * growth**(k-2), max_delay)` scaled by a jitter
+    factor drawn uniformly from [1 - jitter, 1]. `seed=None` uses
+    process randomness; tests pass a seed (and a fake `sleep`) for
+    exact replay.
+    """
+    max_attempts: int = 3
+    base_delay: float = 0.1
+    growth: float = 2.0
+    max_delay: float = 5.0
+    jitter: float = 0.5
+    deadline: Optional[float] = None          # total seconds across attempts
+    classifier: Callable[[BaseException], bool] = default_classifier
+    seed: Optional[int] = None
+    sleep: Callable[[float], None] = time.sleep
+    clock: Callable[[], float] = time.monotonic
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        if not (0.0 <= self.jitter <= 1.0):
+            raise ValueError("jitter must be in [0, 1]")
+
+    def delays(self) -> Tuple[float, ...]:
+        """The backoff schedule (pre-jitter) — one delay per retry."""
+        return tuple(min(self.base_delay * self.growth ** i, self.max_delay)
+                     for i in range(self.max_attempts - 1))
+
+    def call(self, fn: Callable, *args,
+             site: str = "retry",
+             event_log: Optional[EventLog] = None,
+             step: Optional[int] = None,
+             **kwargs):
+        """Run `fn(*args, **kwargs)` under this policy.
+
+        Non-retryable errors propagate immediately (classifier says no).
+        Exhaustion raises `RetryError` chaining the last error. Every
+        re-attempt records a `retry` event; exhaustion records
+        `retry_exhausted`.
+        """
+        events = event_log if event_log is not None else global_event_log()
+        rng = random.Random(self.seed)
+        start = self.clock()
+        last: Optional[BaseException] = None
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                return fn(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001 — classifier decides
+                last = e
+                if not self.classifier(e):
+                    raise
+                if attempt >= self.max_attempts:
+                    break
+                delay = min(self.base_delay * self.growth ** (attempt - 1),
+                            self.max_delay)
+                if self.jitter:
+                    delay *= 1.0 - self.jitter * rng.random()
+                if (self.deadline is not None
+                        and self.clock() - start + delay > self.deadline):
+                    events.record("retry_exhausted", site,
+                                  detail=f"deadline {self.deadline}s hit "
+                                         f"after {attempt} attempt(s): {e!r}",
+                                  step=step)
+                    raise RetryError(site, attempt, e) from e
+                events.record(
+                    "retry", site,
+                    detail=f"attempt {attempt}/{self.max_attempts} failed "
+                           f"({e!r}); backing off {delay:.3f}s",
+                    step=step)
+                self.sleep(delay)
+        assert last is not None
+        events.record("retry_exhausted", site,
+                      detail=f"{self.max_attempts} attempt(s): {last!r}",
+                      step=step)
+        raise RetryError(site, self.max_attempts, last) from last
+
+    def wrap(self, fn: Callable, site: str = "retry",
+             event_log: Optional[EventLog] = None) -> Callable:
+        """`fn` curried under this policy (decorator form)."""
+        def wrapped(*args, **kwargs):
+            return self.call(fn, *args, site=site, event_log=event_log,
+                             **kwargs)
+        wrapped.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapped

@@ -1,0 +1,153 @@
+"""Offline checkpoint-integrity checking (and the deliberate-corruption
+helper the chaos suite uses to manufacture broken checkpoints).
+
+A corrupt orbax step dir is indistinguishable from a good one at the
+`all_steps()` level — the step is listed, `latest_step()` returns it,
+and only an actual restore attempt raises (observed: truncated
+`_METADATA` -> JSONDecodeError; missing chunk files -> FileNotFoundError).
+`verify_checkpoint` front-loads that discovery so an operator can audit
+a checkpoint directory before pointing a 256-chip job at it.
+
+Check levels:
+  shallow  structure only: step dir present, completion metadata
+           (`_CHECKPOINT_METADATA`) present, `state` item dir non-empty,
+           no zero-byte files, item metadata parseable.
+  deep     additionally restores every leaf to host numpy (topology-free
+           OCDBT read) and reports leaf count/bytes + non-finite leaves.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional
+
+COMPLETION_MARKER = "_CHECKPOINT_METADATA"
+
+
+@dataclasses.dataclass
+class StepReport:
+    step: int
+    ok: bool
+    errors: List[str] = dataclasses.field(default_factory=list)
+    n_files: int = 0
+    n_bytes: int = 0
+    n_leaves: Optional[int] = None          # deep only
+    nonfinite_leaves: List[str] = dataclasses.field(default_factory=list)
+
+    def as_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+def _step_dir(directory: str, step: int) -> str:
+    # orbax lays out `<dir>/<step>/` (no padding by default)
+    return os.path.join(directory, str(step))
+
+
+def _scan_files(root: str, report: StepReport) -> None:
+    for dirpath, _, files in os.walk(root):
+        for f in files:
+            p = os.path.join(dirpath, f)
+            try:
+                size = os.path.getsize(p)
+            except OSError as e:
+                report.errors.append(f"unreadable file {p}: {e}")
+                continue
+            report.n_files += 1
+            report.n_bytes += size
+            if size == 0:
+                report.errors.append(
+                    f"zero-byte file (truncated write?): "
+                    f"{os.path.relpath(p, root)}")
+
+
+def verify_step(directory: str, step: int, deep: bool = False) -> StepReport:
+    """Integrity-check one step dir; never raises on corruption — the
+    report carries the errors."""
+    report = StepReport(step=step, ok=True)
+    sdir = _step_dir(directory, step)
+    if not os.path.isdir(sdir):
+        report.ok = False
+        report.errors.append(f"step directory missing: {sdir}")
+        return report
+    if not os.path.exists(os.path.join(sdir, COMPLETION_MARKER)):
+        report.errors.append(
+            f"no {COMPLETION_MARKER} — save may not have completed")
+    state_dir = os.path.join(sdir, "state")
+    if not os.path.isdir(state_dir) or not os.listdir(state_dir):
+        report.errors.append("state item missing or empty")
+    _scan_files(sdir, report)
+    if deep and not report.errors:
+        _deep_check(directory, step, report)
+    report.ok = not report.errors
+    return report
+
+
+def _deep_check(directory: str, step: int, report: StepReport) -> None:
+    import numpy as np
+    from ..trainer.checkpoints import Checkpointer
+    ck = Checkpointer(directory)
+    try:
+        state, _meta = ck.restore_to_host(step=step)
+        import jax
+        leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+        report.n_leaves = len(leaves)
+        for path, leaf in leaves:
+            arr = np.asarray(leaf)
+            if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                report.nonfinite_leaves.append(jax.tree_util.keystr(path))
+    except Exception as e:  # noqa: BLE001 — any failure is the finding
+        report.errors.append(f"deep restore failed: {type(e).__name__}: {e}")
+    finally:
+        ck.close()
+
+
+def verify_checkpoint(directory: str, step: Optional[int] = None,
+                      deep: bool = False,
+                      all_steps: bool = False) -> List[StepReport]:
+    """Check `step` (default: latest), or every step with `all_steps`.
+
+    Returns reports sorted by step. An empty directory yields a single
+    failing pseudo-report (step=-1) rather than raising, so the CLI can
+    exit 1 uniformly.
+    """
+    steps: List[int]
+    if step is not None:
+        steps = [step]
+    else:
+        try:
+            entries = [int(e) for e in os.listdir(directory)
+                       if e.isdigit()
+                       and os.path.isdir(os.path.join(directory, e))]
+        except OSError as e:
+            return [StepReport(step=-1, ok=False,
+                               errors=[f"cannot list {directory}: {e}"])]
+        entries.sort()
+        if not entries:
+            return [StepReport(step=-1, ok=False,
+                               errors=[f"no step dirs under {directory}"])]
+        steps = entries if all_steps else [entries[-1]]
+    return [verify_step(directory, s, deep=deep) for s in steps]
+
+
+def corrupt_step_dir(directory: str, step: int,
+                     mode: str = "garbage") -> int:
+    """Deliberately corrupt a step dir (chaos-test helper).
+
+    mode="garbage"  overwrite every file under `<step>/state` with junk
+                    (observed to make orbax restore raise while the step
+                    stays listed — the worst case for naive restore).
+    mode="truncate" zero out every file (caught by the shallow checker).
+    Returns the number of files damaged.
+    """
+    state_dir = os.path.join(_step_dir(directory, step), "state")
+    n = 0
+    for dirpath, _, files in os.walk(state_dir):
+        for f in files:
+            p = os.path.join(dirpath, f)
+            with open(p, "wb") as fh:
+                if mode == "garbage":
+                    fh.write(b"CORRUPTED-BY-CHAOS-TEST")
+            n += 1
+    if n == 0:
+        raise FileNotFoundError(f"nothing to corrupt under {state_dir}")
+    return n

@@ -1,0 +1,121 @@
+"""Heartbeat watchdog: detects a stalled train step or wedged data
+loader and converts an opaque hang into a structured event + clean
+checkpoint-and-exit.
+
+The monitored loop calls `beat()` once per iteration; a daemon thread
+checks the gap between beats. On a stall it records a `watchdog_stall`
+event and runs `on_stall(gap_seconds)` exactly once per stall episode
+(re-arming when beats resume). The trainer's default action raises
+SIGTERM against its own process, which lands in the existing
+preemption path (`TrainerConfig.checkpoint_on_sigterm`): finish/abandon
+the step, checkpoint, return cleanly — the same guarantee a pod
+eviction gets. SIGTERM (not an in-thread exception) because a truly
+wedged main thread can only be pre-empted at a signal delivery point;
+Python delivers signals even inside `queue.get`/`time.sleep` waits.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from .events import EventLog, global_event_log
+
+
+class Watchdog:
+    """Daemon-thread heartbeat monitor.
+
+    timeout:  max seconds between beats before the stall action fires.
+    on_stall: callback(gap_seconds); called once per stall episode from
+              the watchdog thread. Exceptions are swallowed (recorded).
+    site:     event-log site label, e.g. "train.step".
+    poll:     check cadence; defaults to timeout/4 clamped to [0.05, 1].
+    """
+
+    def __init__(self, timeout: float,
+                 on_stall: Optional[Callable[[float], None]] = None,
+                 site: str = "train.step",
+                 poll: Optional[float] = None,
+                 event_log: Optional[EventLog] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if timeout <= 0:
+            raise ValueError("watchdog timeout must be > 0")
+        self.timeout = timeout
+        self.site = site
+        self.on_stall = on_stall
+        self.poll = poll if poll is not None else min(max(timeout / 4, 0.05),
+                                                      1.0)
+        self._events = event_log if event_log is not None \
+            else global_event_log()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._last_beat = clock()
+        self._paused = 0
+        self._fired_this_episode = False
+        self.stall_count = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- heartbeat -----------------------------------------------------------
+    def beat(self) -> None:
+        with self._lock:
+            self._last_beat = self._clock()
+            self._fired_this_episode = False      # re-arm after recovery
+
+    def pause(self) -> None:
+        """Suspend stall detection (e.g. around a known-long compile)."""
+        with self._lock:
+            self._paused += 1
+
+    def resume(self) -> None:
+        with self._lock:
+            self._paused = max(self._paused - 1, 0)
+            self._last_beat = self._clock()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "Watchdog":
+        if self._thread is not None:
+            return self
+        self.beat()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"flaxdiff-watchdog-{self.site}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=self.poll * 4 + 1.0)
+        self._thread = None
+
+    def __enter__(self) -> "Watchdog":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- monitor thread ------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll):
+            with self._lock:
+                if self._paused or self._fired_this_episode:
+                    continue
+                gap = self._clock() - self._last_beat
+                stalled = gap > self.timeout
+                if stalled:
+                    self._fired_this_episode = True
+                    self.stall_count += 1
+            if stalled:
+                self._events.record(
+                    "watchdog_stall", self.site,
+                    detail=f"no heartbeat for {gap:.2f}s "
+                           f"(timeout {self.timeout}s)")
+                if self.on_stall is not None:
+                    try:
+                        self.on_stall(gap)
+                    except Exception:
+                        from .events import log
+                        log.exception("watchdog on_stall action failed")

@@ -9,7 +9,14 @@ and no per-step host sync (loss is read back only at the log cadence).
 """
 from .autoencoder_trainer import AutoEncoderTrainer, AutoEncoderTrainerConfig
 from .checkpoints import Checkpointer, abstract_state_like
-from .logging import JsonlLogger, MultiLogger, WandbLogger, make_logger, save_image_grid
+from .logging import (
+    JsonlLogger,
+    MultiLogger,
+    WandbLogger,
+    attach_resilience,
+    make_logger,
+    save_image_grid,
+)
 from .optim import flat_optimizer
 from .registry import ModelRegistry
 from .train_state import TrainState
@@ -32,6 +39,7 @@ __all__ = [
     "WandbLogger",
     "MultiLogger",
     "make_logger",
+    "attach_resilience",
     "save_image_grid",
     "ModelRegistry",
     "AutoEncoderTrainer",

@@ -14,7 +14,15 @@ from typing import Optional
 import jax
 import orbax.checkpoint as ocp
 
+from ..resilience import events as _events
+from ..resilience import faults as _faults
+from ..resilience.retry import RetryError, RetryPolicy
 from ..typing import PyTree
+
+# Save-side default: object-store writes fail transiently (429/503/socket
+# resets); a short budget rides them out without stalling training long.
+DEFAULT_SAVE_RETRY = RetryPolicy(max_attempts=3, base_delay=0.2,
+                                 max_delay=2.0)
 
 
 class Checkpointer:
@@ -22,10 +30,23 @@ class Checkpointer:
     simple_trainer.py:230-235, 339-389).
 
     Payload: {"state": TrainState, "meta": {best_loss, ...}}.
+
+    Resilience: saves run under `save_retry` (exponential backoff; see
+    resilience/retry.py) and, on exhaustion, degrade to a structured
+    `save_failed` event instead of killing training — a missed
+    checkpoint costs recovery time, a dead run costs everything.
+    Restores walk BACK across saved steps when the newest one is
+    corrupt/incomplete (`fallback=True`), because a corrupt step is
+    still listed by `all_steps()` and only fails at read time.
+    `last_save_result` exposes the outcome of the most recent `save`
+    ("started" | "skipped_exists" | "failed") so the fit loop does not
+    count a skip/failure as a successful save.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 save_interval_steps: int = 1):
+                 save_interval_steps: int = 1,
+                 save_retry: Optional[RetryPolicy] = DEFAULT_SAVE_RETRY,
+                 event_log: Optional[_events.EventLog] = None):
         directory = os.path.abspath(os.path.expanduser(directory)) \
             if "://" not in directory else directory
         self._mgr = ocp.CheckpointManager(
@@ -36,6 +57,14 @@ class Checkpointer:
                 enable_async_checkpointing=True,
             ),
         )
+        self._save_retry = save_retry
+        self._event_log = event_log
+        self.last_save_result: str = "none"
+
+    @property
+    def _events(self) -> _events.EventLog:
+        return (self._event_log if self._event_log is not None
+                else _events.global_event_log())
 
     @property
     def directory(self) -> str:
@@ -45,26 +74,97 @@ class Checkpointer:
              meta: Optional[dict] = None, force: bool = False) -> bool:
         """Async sharded save; returns True if a save was started. A step
         that already exists is skipped (orbax refuses to overwrite a step
-        even with force=True)."""
+        even with force=True) — recorded as a `save_skipped` event and
+        `last_save_result == "skipped_exists"`, because after a NaN
+        rollback the re-reached step must not masquerade as freshly
+        persisted (the on-disk state is the PRE-rollback one).
+
+        Transient I/O failures retry under `save_retry`; exhaustion
+        degrades to a `save_failed` event and returns False."""
         if step in self._mgr.all_steps():
+            self.last_save_result = "skipped_exists"
+            self._events.record(
+                "save_skipped", "ckpt.save",
+                detail="step already on disk (post-rollback re-reach?); "
+                       "not re-saved", step=step)
             return False
-        # meta is always written so restore can unconditionally request it.
-        return self._mgr.save(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state),
-                meta=ocp.args.JsonSave(dict(meta or {}))),
-            force=force)
+
+        def attempt():
+            _faults.check("ckpt.save", step=step)
+            # meta is always written so restore can unconditionally
+            # request it.
+            return self._mgr.save(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(state),
+                    meta=ocp.args.JsonSave(dict(meta or {}))),
+                force=force)
+
+        try:
+            if self._save_retry is not None:
+                started = self._save_retry.call(
+                    attempt, site="ckpt.save", event_log=self._event_log,
+                    step=step)
+            else:
+                started = attempt()
+        except (RetryError, OSError) as e:
+            # Degrade, don't die: training continues on the device state;
+            # the event stream carries the loss of durability.
+            self.last_save_result = "failed"
+            self._events.record("save_failed", "ckpt.save",
+                                detail=repr(e), step=step)
+            return False
+        self.last_save_result = "started" if started else "skipped_exists"
+        return bool(started)
 
     def restore(self, abstract_state: PyTree,
-                step: Optional[int] = None) -> tuple:
+                step: Optional[int] = None,
+                fallback: bool = True) -> tuple:
         """Restore (state, meta). `abstract_state` is a jax.eval_shape-style
         tree of ShapeDtypeStruct with shardings attached — shards land
-        directly on their devices."""
-        step = self.latest_step() if step is None else step
-        if step is None:
+        directly on their devices.
+
+        With `fallback` (and no explicit `step`), a corrupt/incomplete
+        newest checkpoint walks back to the next older step instead of
+        killing the run; each skip records a `fallback_restore` event.
+        An explicit `step` is restored exactly or raises."""
+        if step is not None:
+            return self._restore_one(abstract_state, step)
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if not steps:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.directory}")
+        if not fallback:
+            return self._restore_one(abstract_state, steps[0])
+        last_err: Optional[Exception] = None
+        for i, s in enumerate(steps):
+            try:
+                _faults.check("ckpt.restore", step=s)
+                restored = self._restore_one(abstract_state, s)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # noqa: BLE001 — corrupt dirs raise
+                # anything (JSONDecodeError, FileNotFoundError, ValueError)
+                last_err = e
+                if i + 1 < len(steps):
+                    self._events.record(
+                        "fallback_restore", "ckpt.restore",
+                        detail=f"step {s} unreadable "
+                               f"({type(e).__name__}: {e}); "
+                               f"falling back to step {steps[i + 1]}",
+                        step=s)
+                continue
+            if i > 0:
+                self._events.record(
+                    "fallback_restore", "ckpt.restore",
+                    detail=f"recovered from step {s} after "
+                           f"{i} corrupt newer step(s)", step=s)
+            return restored
+        raise RuntimeError(
+            f"every checkpoint under {self.directory} failed to restore "
+            f"(steps tried: {steps})") from last_err
+
+    def _restore_one(self, abstract_state: PyTree, step: int) -> tuple:
         try:
             restored = self._mgr.restore(
                 step,
@@ -103,14 +203,18 @@ class Checkpointer:
             lambda _: ocp.RestoreArgs(restore_type=np.ndarray), item)
         import warnings
         with warnings.catch_warnings():
-            # orbax warns "sharding info not provided ... unsafe when
-            # restoring on a different topology" whenever restore args
-            # carry no sharding — including this explicitly-numpy
-            # restore, where no device placement happens at all and the
-            # caveat cannot apply. Suppress THAT warning only; a device
-            # restore goes through restore() which passes real shardings.
+            # orbax warns "sharding info not provided ..." / "Couldn't
+            # find sharding info under RestoreArgs ... unsafe when
+            # restoring on a different topology" (the text varies by
+            # version) whenever restore args carry no sharding —
+            # including this explicitly-numpy restore, where no device
+            # placement happens at all and the caveat cannot apply.
+            # Suppress THOSE warnings only; a device restore goes
+            # through restore() which passes real shardings.
             warnings.filterwarnings(
                 "ignore", message=".*[Ss]harding info not provided.*")
+            warnings.filterwarnings(
+                "ignore", message=".*find sharding info under RestoreArgs.*")
             restored = self._mgr.restore(
                 step,
                 args=ocp.args.Composite(

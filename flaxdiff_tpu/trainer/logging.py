@@ -81,21 +81,37 @@ class JsonlLogger:
 
 
 class WandbLogger:
-    """wandb adapter; raises at construction if wandb is unavailable."""
+    """wandb adapter; raises at construction if wandb is unavailable.
+
+    Pushes run under the unified RetryPolicy (resilience/retry.py): a
+    flaky tracking backend gets backoff + jitter, and exhaustion degrades
+    to a `log_failed` resilience event — metrics loss must never kill a
+    pod run."""
 
     def __init__(self, project: str, name: Optional[str] = None,
-                 config: Optional[dict] = None, **kwargs):
+                 config: Optional[dict] = None, retry=None, **kwargs):
         import wandb  # gated optional dependency
+        from ..resilience.retry import RetryPolicy
         self._wandb = wandb
+        self._retry = retry if retry is not None else RetryPolicy(
+            max_attempts=3, base_delay=0.2, max_delay=2.0)
         self.run = wandb.init(project=project, name=name, config=config,
                               **kwargs)
 
+    def _push(self, payload: Dict[str, Any], step: Optional[int]):
+        from ..resilience import events as _ev
+        try:
+            self._retry.call(self.run.log, payload, step=step,
+                             site="wandb.log")
+        except Exception as e:  # noqa: BLE001 — degrade, never kill a run
+            _ev.record_event("log_failed", "wandb.log", detail=repr(e),
+                             step=step)
+
     def log(self, data: Dict[str, Any], step: Optional[int] = None):
-        self.run.log(data, step=step)
+        self._push(data, step)
 
     def log_images(self, key: str, images, step: Optional[int] = None):
-        self.run.log({key: [self._wandb.Image(im) for im in images]},
-                     step=step)
+        self._push({key: [self._wandb.Image(im) for im in images]}, step)
 
     def finish(self):
         self.run.finish()
@@ -118,6 +134,29 @@ class MultiLogger:
     def finish(self):
         for lg in self.loggers:
             lg.finish()
+
+
+def attach_resilience(logger, event_log=None):
+    """Stream resilience events into `logger` as structured records
+    (kind/site/detail + step), in addition to the counter metrics the
+    trainer merges at log cadence. Returns a detach() callable.
+
+    Subscriber exceptions are swallowed by the EventLog itself, so a
+    broken sink can't break a recovery path."""
+    from ..resilience import events as _ev
+    log_ = event_log if event_log is not None else _ev.global_event_log()
+
+    def push(ev):
+        logger.log({"resilience_event": ev.kind,
+                    "resilience_site": ev.site,
+                    "resilience_detail": ev.detail}, step=ev.step)
+
+    log_.subscribe(push)
+
+    def detach():
+        log_.unsubscribe(push)
+
+    return detach
 
 
 def make_logger(project: Optional[str] = None,

@@ -25,6 +25,8 @@ from ..parallel import fsdp_sharding_tree, sharding_tree
 from ..parallel.mesh import batch_spec
 from ..profiling import compiled_flops, device_peak_flops, mfu
 from ..predictors import PredictionTransform
+from ..resilience import events as _res_events
+from ..resilience import faults as _res_faults
 from ..schedulers.common import NoiseSchedule
 from ..typing import Policy, PyTree
 from .train_state import TrainState
@@ -63,6 +65,14 @@ class TrainerConfig:
     profile_dir: Optional[str] = None
     profile_at_step: int = 10
     profile_steps: int = 5
+    # Heartbeat watchdog (resilience/watchdog.py): None disables. When a
+    # step (or the loader feeding it) stalls past this many seconds, a
+    # `watchdog_stall` event is recorded and the stall action runs:
+    # "sigterm" re-uses the preemption path (clean checkpoint-and-exit),
+    # "flag" only marks stop so the loop exits at its next iteration.
+    # The first step is exempt (jit compile can legitimately exceed it).
+    watchdog_timeout: Optional[float] = None
+    watchdog_action: str = "sigterm"
 
 
 class DiffusionTrainer:
@@ -221,14 +231,21 @@ class DiffusionTrainer:
             step, self.state, meta={"best_loss": float(self.best_loss)},
             force=force)
 
-    def restore_checkpoint(self, step: Optional[int] = None) -> int:
+    def restore_checkpoint(self, step: Optional[int] = None,
+                           fallback: bool = True) -> int:
         """Restore state (sharded, shards placed directly on the mesh);
-        returns the restored step (reference simple_trainer.py:339-367)."""
+        returns the restored step (reference simple_trainer.py:339-367).
+
+        With `fallback` (default) a corrupt/incomplete latest checkpoint
+        walks back to the newest readable step instead of killing the
+        run (`fallback_restore` events record each skip); an explicit
+        `step` is always restored exactly or raises."""
         if self.checkpointer is None:
             raise ValueError("trainer has no checkpointer")
         from .checkpoints import abstract_state_like
         abstract = abstract_state_like(self.state)
-        self.state, meta = self.checkpointer.restore(abstract, step=step)
+        self.state, meta = self.checkpointer.restore(abstract, step=step,
+                                                     fallback=fallback)
         best = float(meta.get("best_loss", float("inf")))
         # best_loss == 0 is the reference's corrupt-checkpoint sentinel
         # (simple_trainer.py:352) — reset rather than trust it.
@@ -307,7 +324,20 @@ class DiffusionTrainer:
         peak = device_peak_flops()
         flops = None
         history: Dict[str, Any] = {"steps": [], "loss": [], "imgs_per_sec": [],
-                                   "mfu": [], "preempted": False}
+                                   "mfu": [], "preempted": False,
+                                   "watchdog_fired": False,
+                                   "saves": {"started": 0,
+                                             "skipped_exists": 0,
+                                             "failed": 0}}
+        events = _res_events.global_event_log()
+        fault_plan = _res_faults.active_plan()
+        nan_pending = False     # step.nan fault armed for next loss read
+
+        def count_save():
+            res = (self.checkpointer.last_save_result
+                   if self.checkpointer is not None else "none")
+            if res in history["saves"]:
+                history["saves"][res] += 1
 
         # SIGTERM -> finish the current step, checkpoint, return. Only the
         # main thread may install handlers; elsewhere (e.g. fit driven
@@ -326,6 +356,26 @@ class DiffusionTrainer:
                 handler_installed = True
             except ValueError:
                 pass
+
+        # Heartbeat watchdog: turns a wedged step/loader into a clean
+        # checkpoint-and-exit (resilience/watchdog.py). The "sigterm"
+        # action reuses the preemption path above; the kill only fires
+        # when the handler is actually installed, else it would be a
+        # real termination.
+        watchdog = None
+        if cfg.watchdog_timeout is not None:
+            import os as _os
+
+            from ..resilience.watchdog import Watchdog
+
+            def _on_stall(gap: float):
+                history["watchdog_fired"] = True
+                stop["flag"] = True
+                if cfg.watchdog_action == "sigterm" and handler_installed:
+                    _os.kill(_os.getpid(), signal.SIGTERM)
+            watchdog = Watchdog(cfg.watchdog_timeout, on_stall=_on_stall,
+                                site="train.step", event_log=events)
+            watchdog.start()
 
         profile_ctx = None
         # Clamp the capture window into [1, total_steps] so a short fit
@@ -348,11 +398,26 @@ class DiffusionTrainer:
             batch = next(data)
             global_batch = self.put_batch(batch)
             for i in range(total_steps):
+                if watchdog is not None:
+                    watchdog.beat()
                 if stop["flag"]:
                     # the post-loop force-save persists the state; here
                     # only mark and stop
                     history["preempted"] = True
+                    events.record("preempt", "train.step",
+                                  detail="SIGTERM (or watchdog) — "
+                                         "checkpointing and returning",
+                                  step=i)
                     break
+                if fault_plan is not None:
+                    # chaos sites (use error="flag" specs): a NaN poisons
+                    # the next loss readback so the rollback path runs; a
+                    # sigterm exercises the preemption path end-to-end.
+                    if fault_plan.check("step.nan", step=i + 1):
+                        nan_pending = True
+                    if fault_plan.check("host.sigterm", step=i + 1):
+                        import os as _os
+                        _os.kill(_os.getpid(), signal.SIGTERM)
                 if cfg.profile_dir is not None:
                     from ..profiling import trace
                     if i + 1 == profile_at and profile_ctx is None:
@@ -364,7 +429,12 @@ class DiffusionTrainer:
                         profile_ctx.__exit__(None, None, None)
                         profile_ctx = None
                 current = global_batch
+                if watchdog is not None and i == 0:
+                    # first call pays jit compile — not a stall
+                    watchdog.pause()
                 pending_loss = self.train_step(current)
+                if watchdog is not None and i == 0:
+                    watchdog.resume()
                 if i + 1 < total_steps:
                     batch = next(data)
                     global_batch = self.put_batch(batch)
@@ -372,8 +442,10 @@ class DiffusionTrainer:
 
                 if (i + 1) % cfg.log_every == 0 or i == total_steps - 1:
                     loss = float(pending_loss)
+                    if nan_pending:
+                        loss, nan_pending = float("nan"), False
                     if not np.isfinite(loss) or loss <= cfg.abnormal_loss_floor:
-                        self._recover(loss)
+                        self._recover(loss, step=i + 1)
                         steps_in_window = 0
                         log_t0 = time.perf_counter()
                         continue
@@ -394,6 +466,9 @@ class DiffusionTrainer:
                     metrics = {"imgs_per_sec": ips}
                     if step_mfu is not None:
                         metrics["mfu"] = step_mfu
+                    # resilience counters ride the normal metric stream
+                    # (JSONL/wandb via whatever logger the callback wraps)
+                    metrics.update(events.summary())
                     for cb in callbacks:
                         cb(i + 1, loss, metrics)
                     if cfg.keep_best_state and loss < self.best_loss:
@@ -408,18 +483,29 @@ class DiffusionTrainer:
                     # still log_every-1 steps away (VERDICT r1 weak #4). The
                     # sync this forces is amortized over save_every steps.
                     loss_now = float(pending_loss)
+                    if nan_pending:
+                        loss_now, nan_pending = float("nan"), False
                     if (not np.isfinite(loss_now)
                             or loss_now <= cfg.abnormal_loss_floor):
-                        self._recover(loss_now)
+                        self._recover(loss_now, step=i + 1)
                     else:
                         self.save_checkpoint()
+                        count_save()
 
+            # The final save can legitimately outlast the watchdog timeout
+            # (sync flush of an async save) — stand the watchdog down
+            # first so it cannot SIGTERM a healthy shutdown.
+            if watchdog is not None:
+                watchdog.stop()
             # Final force-save runs BEFORE the handler restore in `finally`:
             # a second SIGTERM arriving during this save — the exact window
             # preemption handling exists to protect — must hit _on_term (a
             # harmless re-mark of stop["flag"]), not the default action.
             self.save_checkpoint(force=True)
+            count_save()
         finally:
+            if watchdog is not None:
+                watchdog.stop()
             if profile_ctx is not None:
                 # sync before closing so async-dispatched steps' device
                 # activity lands in the trace (windows that run past the
@@ -433,13 +519,22 @@ class DiffusionTrainer:
                               else signal.SIG_DFL)
         history["final_loss"] = losses[-1] if losses else float("nan")
         history["best_loss"] = self.best_loss
+        history["resilience"] = events.summary()
         return history
 
-    def _recover(self, bad_loss: float):
+    def _recover(self, bad_loss: float, step: Optional[int] = None):
         """Abnormal-loss recovery (reference simple_trainer.py:542-575):
         scan params, clear compilation caches are unnecessary here (state
         is functional) — restore the best state if we have one."""
-        if self.best_state is not None:
+        rolled_back = self.best_state is not None
+        _res_events.global_event_log().record(
+            "rollback", "train.step",
+            detail=f"abnormal loss {bad_loss}; "
+                   + ("restored best state"
+                      if rolled_back else "no best state — continuing "
+                      "with fresh rng fold"),
+            step=step)
+        if rolled_back:
             self.state = jax.tree_util.tree_map(jnp.copy, self.best_state)
         # else: keep going with fresh RNG fold — the step folds rng by step
         # counter, so the next batch draws different noise.

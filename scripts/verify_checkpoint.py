@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Offline checkpoint-integrity audit (resilience/verify.py CLI).
+
+A corrupt orbax step dir is listed by `all_steps()` like a good one and
+only fails at restore time — run this BEFORE pointing a pod job at a
+checkpoint directory, or after any run that logged `save_failed` /
+`fallback_restore` resilience events.
+
+Usage:
+    python scripts/verify_checkpoint.py runs/ckpt              # latest step
+    python scripts/verify_checkpoint.py runs/ckpt --all        # every step
+    python scripts/verify_checkpoint.py runs/ckpt --step 400 --deep
+    python scripts/verify_checkpoint.py runs/ckpt --json
+
+`--deep` additionally restores every leaf to host numpy (topology-free)
+and flags non-finite tensors. Exit code 0 iff every checked step is
+intact.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("directory", help="checkpoint directory (orbax layout)")
+    ap.add_argument("--step", type=int, default=None,
+                    help="check this step only (default: latest)")
+    ap.add_argument("--all", action="store_true", dest="all_steps",
+                    help="check every step dir")
+    ap.add_argument("--deep", action="store_true",
+                    help="restore every leaf to host numpy and check "
+                         "finiteness (slower; needs jax+orbax)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+
+    from flaxdiff_tpu.resilience.verify import verify_checkpoint
+    reports = verify_checkpoint(args.directory, step=args.step,
+                                deep=args.deep, all_steps=args.all_steps)
+
+    if args.as_json:
+        print(json.dumps([r.as_dict() for r in reports], indent=2))
+    else:
+        for r in reports:
+            status = "OK " if r.ok else "BAD"
+            extra = f", {r.n_leaves} leaves" if r.n_leaves is not None else ""
+            print(f"[{status}] step {r.step}: {r.n_files} files, "
+                  f"{r.n_bytes} bytes{extra}")
+            for err in r.errors:
+                print(f"      - {err}")
+            for leaf in r.nonfinite_leaves:
+                print(f"      - non-finite values in {leaf}")
+    ok = all(r.ok and not r.nonfinite_leaves for r in reports)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,500 @@
+"""Unit tests for the resilience layer: RetryPolicy, FaultPlan,
+EventLog, Watchdog, checkpoint integrity tooling, and the data-layer
+wiring (retry-aware fetcher, starvation events)."""
+import json
+import time
+
+import numpy as np
+import pytest
+
+from flaxdiff_tpu import resilience as R
+from flaxdiff_tpu.resilience.retry import RetryError
+
+
+# -- EventLog ----------------------------------------------------------------
+
+def test_event_log_counts_and_summary():
+    ev = R.EventLog("t")
+    ev.record("retry", "ckpt.save", step=3)
+    ev.record("retry", "ckpt.save")
+    ev.record("save_failed", "ckpt.save", detail="boom")
+    assert ev.count("retry") == 2
+    assert ev.count("retry", "ckpt.save") == 2
+    assert ev.count(site="ckpt.save") == 3
+    assert ev.summary() == {"resilience/retry.ckpt.save": 2,
+                            "resilience/save_failed.ckpt.save": 1}
+    assert ev.events("save_failed")[0].detail == "boom"
+
+
+def test_event_log_subscribers_isolated_from_failures():
+    ev = R.EventLog("t")
+    got = []
+    ev.subscribe(lambda e: (_ for _ in ()).throw(RuntimeError("bad sink")))
+    ev.subscribe(got.append)
+    ev.record("rollback", "train.step")
+    assert len(got) == 1 and got[0].kind == "rollback"
+
+
+def test_event_log_drain_since_cursor():
+    ev = R.EventLog("t")
+    ev.record("a", "s")
+    evs, cur = ev.drain_since(0)
+    assert [e.kind for e in evs] == ["a"]
+    ev.record("b", "s")
+    evs, cur = ev.drain_since(cur)
+    assert [e.kind for e in evs] == ["b"]
+    evs, _ = ev.drain_since(cur)
+    assert evs == []
+
+
+def test_use_event_log_swaps_global():
+    ev = R.EventLog("scoped")
+    before = R.global_event_log()
+    with R.use_event_log(ev):
+        assert R.global_event_log() is ev
+        R.record_event("retry", "x")
+    assert R.global_event_log() is before
+    assert ev.count("retry", "x") == 1
+
+
+# -- RetryPolicy -------------------------------------------------------------
+
+def test_retry_succeeds_after_transient_failures():
+    calls = {"n": 0}
+    slept = []
+    pol = R.RetryPolicy(max_attempts=4, base_delay=0.1, seed=0,
+                        sleep=slept.append)
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    ev = R.EventLog("t")
+    assert pol.call(flaky, site="s", event_log=ev) == "ok"
+    assert calls["n"] == 3
+    assert ev.count("retry", "s") == 2
+    # exponential growth shows through jitter (jitter <= 50%)
+    assert slept[1] > slept[0]
+
+
+def test_retry_backoff_deterministic_with_seed():
+    def run():
+        slept = []
+        pol = R.RetryPolicy(max_attempts=3, seed=42, sleep=slept.append)
+        with pytest.raises(RetryError):
+            pol.call(lambda: (_ for _ in ()).throw(OSError("x")),
+                     site="s", event_log=R.EventLog("t"))
+        return slept
+    assert run() == run()
+
+
+def test_retry_non_retryable_propagates_immediately():
+    class Http404(Exception):
+        code = 404
+
+    calls = {"n": 0}
+
+    def dead():
+        calls["n"] += 1
+        raise Http404("gone")
+
+    pol = R.RetryPolicy(max_attempts=5, sleep=lambda _: None)
+    with pytest.raises(Http404):
+        pol.call(dead, site="s", event_log=R.EventLog("t"))
+    assert calls["n"] == 1      # no budget burned on a dead URL
+
+
+def test_retry_exhaustion_raises_retry_error_with_cause():
+    pol = R.RetryPolicy(max_attempts=2, sleep=lambda _: None)
+    ev = R.EventLog("t")
+    with pytest.raises(RetryError) as exc:
+        pol.call(lambda: (_ for _ in ()).throw(OSError("io")),
+                 site="s", event_log=ev)
+    assert isinstance(exc.value.last, OSError)
+    assert exc.value.attempts == 2
+    assert ev.count("retry_exhausted", "s") == 1
+
+
+def test_retry_deadline_cuts_budget_short():
+    clock = {"t": 0.0}
+
+    def fake_sleep(d):
+        clock["t"] += d
+
+    pol = R.RetryPolicy(max_attempts=10, base_delay=1.0, jitter=0.0,
+                        deadline=2.5, sleep=fake_sleep,
+                        clock=lambda: clock["t"])
+    calls = {"n": 0}
+
+    def fail():
+        calls["n"] += 1
+        raise OSError("x")
+
+    with pytest.raises(RetryError):
+        pol.call(fail, site="s", event_log=R.EventLog("t"))
+    # delays 1, 2 would exceed the 2.5 s deadline on the second backoff
+    assert calls["n"] == 2
+
+
+def test_default_classifier_http_codes():
+    class E(Exception):
+        def __init__(self, code):
+            self.code = code
+
+    assert not R.default_classifier(E(404))
+    assert not R.default_classifier(E(403))
+    assert R.default_classifier(E(429))
+    assert R.default_classifier(E(503))
+    assert R.default_classifier(OSError("reset"))
+    assert not R.default_classifier(ValueError("bug"))
+    assert not R.default_classifier(KeyboardInterrupt())
+
+
+# -- FaultPlan ---------------------------------------------------------------
+
+def test_fault_plan_fires_at_scheduled_hit():
+    plan = R.FaultPlan([R.FaultSpec("ckpt.save", at=(2,), times=1)])
+    with plan.installed(), R.use_event_log(R.EventLog("t")) as ev:
+        assert R.fault_check("ckpt.save") is False
+        with pytest.raises(R.InjectedFault):
+            R.fault_check("ckpt.save")
+        assert R.fault_check("ckpt.save") is False   # times=1 exhausted
+        assert ev.count("fault_injected", "ckpt.save") == 1
+
+
+def test_fault_plan_http_error_kind():
+    plan = R.FaultPlan([R.FaultSpec("data.fetch", at=(1,), error="http404")])
+    with plan.installed(), R.use_event_log(R.EventLog("t")):
+        with pytest.raises(R.InjectedHTTPError) as exc:
+            R.fault_check("data.fetch")
+        assert exc.value.code == 404
+
+
+def test_fault_plan_flag_kind_returns_true():
+    plan = R.FaultPlan([R.FaultSpec("step.nan", at=(1,), error="flag")])
+    with plan.installed(), R.use_event_log(R.EventLog("t")):
+        assert R.fault_check("step.nan") is True
+        assert R.fault_check("step.nan") is False
+
+
+def test_fault_plan_prob_deterministic_given_seed():
+    def decisions(seed):
+        plan = R.FaultPlan([R.FaultSpec("s", prob=0.5, error="flag")],
+                           seed=seed)
+        with plan.installed(), R.use_event_log(R.EventLog("t")):
+            return [R.fault_check("s") for _ in range(32)]
+    assert decisions(7) == decisions(7)
+    assert decisions(7) != decisions(8)
+    assert any(decisions(7))            # p=0.5 over 32 draws
+
+
+def test_fault_plan_stall_sleeps():
+    plan = R.FaultPlan([R.FaultSpec("data.stall", at=(1,), error="stall",
+                                    delay=3.0)])
+    slept = []
+    with R.use_event_log(R.EventLog("t")):
+        assert plan.maybe_stall("data.stall", sleep=slept.append) == 3.0
+        assert plan.maybe_stall("data.stall", sleep=slept.append) == 0.0
+    assert slept == [3.0]
+
+
+def test_fault_plan_json_roundtrip_and_env():
+    plan = R.FaultPlan([R.FaultSpec("ckpt.save", at=(1, 3), times=2),
+                        R.FaultSpec("data.fetch", prob=0.25)], seed=9)
+    clone = R.FaultPlan.from_json(plan.to_json())
+    assert json.loads(clone.to_json()) == json.loads(plan.to_json())
+    env_plan = R.FaultPlan.from_env({R.faults.ENV_VAR: plan.to_json()})
+    assert env_plan is not None and env_plan.seed == 9
+    assert R.FaultPlan.from_env({}) is None
+
+
+def test_no_active_plan_is_noop():
+    prev = R.install_plan(None)
+    try:
+        assert R.fault_check("anything") is False
+        assert R.fault_stall("anything") == 0.0
+    finally:
+        R.install_plan(prev)
+
+
+# -- Watchdog ----------------------------------------------------------------
+
+def test_watchdog_fires_once_per_episode_and_rearms():
+    fired = []
+    ev = R.EventLog("t")
+    wd = R.Watchdog(0.15, on_stall=fired.append, site="t", poll=0.03,
+                    event_log=ev)
+    with wd:
+        time.sleep(0.4)             # one stall episode, one firing
+        assert len(fired) == 1
+        wd.beat()                   # recovery re-arms
+        time.sleep(0.4)
+    assert len(fired) == 2
+    assert wd.stall_count == 2
+    assert ev.count("watchdog_stall", "t") == 2
+
+
+def test_watchdog_pause_suppresses():
+    fired = []
+    wd = R.Watchdog(0.1, on_stall=fired.append, site="t", poll=0.02,
+                    event_log=R.EventLog("t"))
+    with wd:
+        wd.pause()
+        time.sleep(0.3)
+        assert fired == []
+        wd.resume()
+        time.sleep(0.3)
+        assert len(fired) == 1
+
+
+def test_watchdog_survives_bad_on_stall():
+    def explode(gap):
+        raise RuntimeError("action failed")
+    wd = R.Watchdog(0.05, on_stall=explode, site="t", poll=0.02,
+                    event_log=R.EventLog("t"))
+    with wd:
+        time.sleep(0.2)
+    assert wd.stall_count == 1      # thread did not die mid-episode
+
+
+# -- checkpoint integrity ----------------------------------------------------
+
+def _save_steps(directory, steps):
+    from flaxdiff_tpu.trainer.checkpoints import Checkpointer
+    ck = Checkpointer(str(directory))
+    state = {"w": np.arange(8.0)}
+    for s in steps:
+        assert ck.save(s, state, meta={"best_loss": 1.0})
+    ck.wait_until_finished()
+    return ck
+
+
+def test_verify_checkpoint_good_and_corrupt(tmp_path):
+    ck = _save_steps(tmp_path, [2, 4])
+    reports = R.verify_checkpoint(str(tmp_path), all_steps=True, deep=True)
+    assert [r.step for r in reports] == [2, 4]
+    assert all(r.ok for r in reports)
+    assert all(r.n_leaves == 1 for r in reports)
+
+    R.corrupt_step_dir(str(tmp_path), 4)
+    rep = R.verify_checkpoint(str(tmp_path), step=4, deep=True)[0]
+    assert not rep.ok and any("deep restore failed" in e for e in rep.errors)
+    # shallow still passes structure (garbage keeps file sizes nonzero);
+    # truncation is caught shallow
+    R.corrupt_step_dir(str(tmp_path), 2, mode="truncate")
+    rep2 = R.verify_checkpoint(str(tmp_path), step=2)[0]
+    assert not rep2.ok and any("zero-byte" in e for e in rep2.errors)
+    ck.close()
+
+
+def test_verify_checkpoint_empty_dir(tmp_path):
+    reports = R.verify_checkpoint(str(tmp_path))
+    assert len(reports) == 1 and not reports[0].ok
+
+
+def test_verify_checkpoint_cli(tmp_path, capsys):
+    from scripts.verify_checkpoint import main
+    ck = _save_steps(tmp_path / "ck", [2])
+    assert main([str(tmp_path / "ck")]) == 0
+    assert "[OK ] step 2" in capsys.readouterr().out
+    R.corrupt_step_dir(str(tmp_path / "ck"), 2)
+    assert main([str(tmp_path / "ck"), "--deep", "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report[0]["step"] == 2 and not report[0]["ok"]
+    ck.close()
+
+
+def test_save_skip_and_degraded_failure_events(tmp_path):
+    from flaxdiff_tpu.trainer.checkpoints import Checkpointer
+    ev = R.EventLog("t")
+    ck = Checkpointer(str(tmp_path), event_log=ev)
+    state = {"w": np.zeros(4)}
+    assert ck.save(2, state)
+    ck.wait_until_finished()
+    # duplicate step: skipped, surfaced, not "started"
+    assert ck.save(2, state) is False
+    assert ck.last_save_result == "skipped_exists"
+    assert ev.count("save_skipped", "ckpt.save") == 1
+    # unrecoverable I/O fault: degrade to False + save_failed event
+    plan = R.FaultPlan([R.FaultSpec("ckpt.save", at=(1, 2, 3, 4, 5))])
+    with plan.installed():
+        assert ck.save(4, state) is False
+    assert ck.last_save_result == "failed"
+    assert ev.count("save_failed", "ckpt.save") == 1
+    assert ev.count("retry", "ckpt.save") == 2        # 3 attempts total
+    ck.close()
+
+
+def test_restore_fallback_on_injected_fault(tmp_path):
+    from flaxdiff_tpu.trainer.checkpoints import Checkpointer
+    ev = R.EventLog("t")
+    ck = _save_steps(tmp_path, [2, 4])
+    ck2 = Checkpointer(str(tmp_path), event_log=ev)
+    plan = R.FaultPlan([R.FaultSpec("ckpt.restore", at=(1,), times=1)])
+    with plan.installed(), R.use_event_log(ev):
+        state, meta = ck2.restore({"w": np.zeros(8)})
+    np.testing.assert_array_equal(np.asarray(state["w"]), np.arange(8.0))
+    assert ev.count("fallback_restore", "ckpt.restore") >= 1
+    assert meta.get("best_loss") == 1.0
+    ck.close()
+    ck2.close()
+
+
+def test_restore_explicit_step_does_not_fall_back(tmp_path):
+    ck = _save_steps(tmp_path, [2, 4])
+    R.corrupt_step_dir(str(tmp_path), 4)
+    with pytest.raises(Exception):
+        ck.restore({"w": np.zeros(8)}, step=4)
+    ck.close()
+
+
+def test_restore_all_corrupt_raises(tmp_path):
+    ck = _save_steps(tmp_path, [2, 4])
+    R.corrupt_step_dir(str(tmp_path), 2)
+    R.corrupt_step_dir(str(tmp_path), 4)
+    with R.use_event_log(R.EventLog("t")):
+        with pytest.raises(RuntimeError, match="every checkpoint"):
+            ck.restore({"w": np.zeros(8)})
+    ck.close()
+
+
+# -- data-layer wiring -------------------------------------------------------
+
+def test_url_fetcher_skips_non_retryable_http(tmp_path):
+    import urllib.error
+    from flaxdiff_tpu.data.online_loader import default_url_fetcher
+    calls = {"n": 0}
+
+    def opener(url, timeout=None):
+        calls["n"] += 1
+        raise urllib.error.HTTPError(url, 404, "not found", {}, None)
+
+    fetch = default_url_fetcher(
+        opener=opener,
+        policy=R.RetryPolicy(max_attempts=5, sleep=lambda _: None))
+    with R.use_event_log(R.EventLog("t")):
+        with pytest.raises(urllib.error.HTTPError):
+            fetch("http://dead.example/x.jpg")
+    assert calls["n"] == 1          # 404 did not burn the retry budget
+
+
+def test_url_fetcher_retries_transient_then_succeeds():
+    import contextlib
+    import io
+    from flaxdiff_tpu.data.online_loader import default_url_fetcher
+    calls = {"n": 0}
+
+    def opener(url, timeout=None):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("connection reset")
+        return contextlib.closing(io.BytesIO(b"IMAGEBYTES"))
+
+    ev = R.EventLog("t")
+    fetch = default_url_fetcher(
+        opener=opener,
+        policy=R.RetryPolicy(max_attempts=3, sleep=lambda _: None))
+    with R.use_event_log(ev):
+        assert fetch("http://flaky.example/x.jpg") == b"IMAGEBYTES"
+    assert calls["n"] == 3
+    assert ev.count("retry", "data.fetch") == 2
+
+
+def _image_records(n=8):
+    rng = np.random.default_rng(0)
+    return [{"image": rng.integers(0, 255, (16, 16, 3)).astype(np.uint8)}
+            for _ in range(n)]
+
+
+def _first_n_filter(n):
+    """Admit exactly `n` samples, then reject everything: workers stay
+    alive but the pipeline starves after one batch (thread-safe)."""
+    import threading
+    lock = threading.Lock()
+    left = {"n": n}
+
+    def keep(sample):
+        with lock:
+            if left["n"] > 0:
+                left["n"] -= 1
+                return True
+            return False
+
+    return keep
+
+
+def test_loader_starvation_warn_emits_event():
+    from flaxdiff_tpu.data.online_loader import OnlineStreamingDataLoader
+    ev = R.EventLog("t")
+    loader = OnlineStreamingDataLoader(
+        _image_records(), batch_size=4, image_size=16, num_threads=2,
+        timeout=0.5, process_index=0, process_count=1,
+        filter_fn=_first_n_filter(4))
+    with R.use_event_log(ev):
+        it = iter(loader)
+        first = next(it)                     # the only real batch
+        assert first["image"].shape[0] == 4
+        batch = next(it)                     # starved round
+        assert ev.count("starvation", "data.loader") >= 1
+        assert batch["image"].shape[0] == 4  # zero fallback, same structure
+        assert float(np.abs(batch["image"]).sum()) == 0.0
+    loader.stop()
+
+
+def test_loader_starvation_raise_fails_fast():
+    from flaxdiff_tpu.data.online_loader import OnlineStreamingDataLoader
+    ev = R.EventLog("t")
+    loader = OnlineStreamingDataLoader(
+        _image_records(), batch_size=4, image_size=16, num_threads=2,
+        timeout=0.5, process_index=0, process_count=1,
+        filter_fn=_first_n_filter(4), starvation_action="raise")
+    with R.use_event_log(ev):
+        it = iter(loader)
+        next(it)
+        with pytest.raises(RuntimeError, match="starved"):
+            next(it)
+        assert ev.count("starvation", "data.loader") == 1
+    loader.stop()
+
+
+def test_loader_rejects_bad_starvation_action():
+    from flaxdiff_tpu.data.online_loader import OnlineStreamingDataLoader
+    with pytest.raises(ValueError, match="starvation_action"):
+        OnlineStreamingDataLoader(_image_records(), starvation_action="oops",
+                                  process_index=0, process_count=1)
+
+
+def test_prefetch_error_records_event():
+    from flaxdiff_tpu.data.prefetch import prefetch_map
+
+    def bad_source():
+        yield 1
+        raise RuntimeError("source died")
+
+    ev = R.EventLog("t")
+    with R.use_event_log(ev):
+        it = prefetch_map(lambda x: x, bad_source())
+        assert next(it) == 1
+        with pytest.raises(RuntimeError, match="source died"):
+            list(it)
+    assert ev.count("pipeline_error", "data.prefetch") == 1
+
+
+# -- logging surface ---------------------------------------------------------
+
+def test_attach_resilience_streams_events(tmp_path):
+    from flaxdiff_tpu.trainer.logging import JsonlLogger, attach_resilience
+    ev = R.EventLog("t")
+    lg = JsonlLogger(str(tmp_path / "log.jsonl"))
+    detach = attach_resilience(lg, ev)
+    ev.record("save_failed", "ckpt.save", detail="disk full", step=7)
+    detach()
+    ev.record("retry", "ckpt.save")          # after detach: not streamed
+    lg.finish()
+    lines = [json.loads(l) for l in open(tmp_path / "log.jsonl")]
+    assert len(lines) == 1
+    assert lines[0]["resilience_event"] == "save_failed"
+    assert lines[0]["resilience_site"] == "ckpt.save"
+    assert lines[0]["step"] == 7

@@ -107,6 +107,11 @@ def parse_args(argv=None):
     p.add_argument("--profile_dir", default=None,
                    help="capture a jax.profiler trace of a few post-warmup "
                         "steps into this directory")
+    p.add_argument("--watchdog_timeout", type=float, default=None,
+                   help="seconds without a completed step before the "
+                        "train-loop watchdog checkpoints and exits "
+                        "cleanly (docs/RESILIENCE.md); default off. Size "
+                        "it at several multiples of the step time.")
     p.add_argument("--val_every", type=int, default=0,
                    help="0 disables in-loop validation")
     p.add_argument("--val_samples", type=int, default=8)
@@ -376,6 +381,11 @@ def main(argv=None):
                          jsonl_path=os.path.join(args.checkpoint_dir,
                                                  "train_log.jsonl"),
                          **wandb_kwargs)
+    # stream resilience events (retries, fallback restores, watchdog
+    # stalls, ...) into the run log as structured records, in addition
+    # to the counter metrics fit merges at log cadence
+    from flaxdiff_tpu.trainer import attach_resilience
+    attach_resilience(logger)
     if args.wandb_resume:
         has_local = any(d.isdigit()
                         for d in os.listdir(args.checkpoint_dir))
@@ -414,7 +424,8 @@ def main(argv=None):
                              uncond_prob=args.uncond_prob,
                              log_every=args.log_every, seed=args.seed,
                              profile_dir=args.profile_dir,
-                             flat_params=args.flat_params),
+                             flat_params=args.flat_params,
+                             watchdog_timeout=args.watchdog_timeout),
         policy=policy, null_cond=null_cond, checkpointer=ckpt,
         autoencoder=autoencoder)
 
