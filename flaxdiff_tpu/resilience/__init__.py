@@ -6,19 +6,33 @@ the steady state (ROADMAP north star; Pulse arXiv:2606.19163 treats
 elasticity as first-class). This package centralizes what used to be
 ad-hoc per-module handling:
 
-  events    structured resilience-event log (counters + subscribers),
-            surfaced through trainer/logging.py to JSONL/wandb/stdout
-  faults    seedable `FaultPlan` arming named sites (ckpt.save,
-            data.fetch, step.nan, ...) — chaos runs replay in pytest
-  retry     `RetryPolicy`: exponential backoff, jitter, deadline,
-            non-retryable classification
-  watchdog  heartbeat thread turning hangs into checkpoint-and-exit
-  verify    offline checkpoint-integrity checker (+ chaos corruption
-            helper); CLI in scripts/verify_checkpoint.py
+  events        structured resilience-event log (counters + subscribers),
+                surfaced through trainer/logging.py to JSONL/wandb/stdout
+  faults        seedable `FaultPlan` arming named sites (ckpt.save,
+                data.fetch, step.nan, ...) — chaos runs replay in pytest
+  retry         `RetryPolicy`: exponential backoff, jitter, deadline,
+                non-retryable classification
+  watchdog      heartbeat thread turning hangs into checkpoint-and-exit
+  verify        offline checkpoint-integrity checker (+ chaos corruption
+                helper); CLI in scripts/verify_checkpoint.py
+  coordination  multi-host restart as ONE consensus event: step-ledger
+                two-phase checkpoint commits, consensus restore, crash
+                barriers with deadlines (docs/RESILIENCE.md)
 
 Dependency direction: trainer/ and data/ import resilience; resilience
 imports neither (verify's deep check lazily uses the Checkpointer).
 """
+from .coordination import (
+    BarrierTimeout,
+    ConsensusError,
+    CoordinationError,
+    InMemoryTransport,
+    JaxDistributedTransport,
+    RestartCoordinator,
+    StepLedger,
+    Transport,
+    default_transport,
+)
 from .events import (
     EventLog,
     ResilienceEvent,
@@ -63,4 +77,13 @@ __all__ = [
     "verify_checkpoint",
     "verify_step",
     "corrupt_step_dir",
+    "CoordinationError",
+    "BarrierTimeout",
+    "ConsensusError",
+    "StepLedger",
+    "Transport",
+    "InMemoryTransport",
+    "JaxDistributedTransport",
+    "RestartCoordinator",
+    "default_transport",
 ]

@@ -1,0 +1,527 @@
+"""Coordinated multi-host restart: step-ledger commits, consensus
+restore, and crash barriers.
+
+PR 1's resilience layer recovers each host independently — after
+asymmetric checkpoint corruption, `fallback_restore`'s walk-back can
+pick DIFFERENT steps on different hosts, a divergent world that wedges
+or silently corrupts a pod-scale run. Elastic-recovery systems (Pulse,
+arXiv:2606.19163) treat restart as one coordinated, consensus-driven
+event; this module provides the three primitives that make restore,
+save-commit, and crash handling pod-consistent:
+
+  StepLedger           external record of which checkpoint steps are
+                       COMMITTED (every process finished writing) —
+                       `ledger.jsonl` in the checkpoint dir, written
+                       only by process 0, fsync'd per entry. A step
+                       absent from the ledger is never restorable.
+  Transport            pluggable world-communication: a real
+                       `jax.distributed` coordination-service backend
+                       (timeout-capable barriers + key-value store) and
+                       an in-memory backend so every consensus path
+                       runs single-process on CPU in tier-1 tests.
+  RestartCoordinator   the protocol: two-phase checkpoint commit
+                       (all-wrote barrier -> ledger entry -> ack
+                       barrier), consensus restore (intersect the
+                       hosts' locally-valid committed-step sets, take
+                       the max, broadcast), and crash barriers (a dead
+                       host turns into BarrierTimeout on the survivors
+                       within a deadline, never an indefinite hang in
+                       collectives).
+
+Elastic re-admission: restore decisions derive only from shared state
+(the ledger + the checkpoint dir), never from host identity, so a
+replacement host joining the next launch participates in consensus
+like any original member; `RestartCoordinator.on_lost` is the hook for
+schedulers that want to trigger that relaunch.
+
+Dependency direction: trainer/checkpoints.py imports this module;
+this module imports nothing from trainer/.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, Optional
+
+from .events import EventLog, global_event_log
+
+LEDGER_FILENAME = "ledger.jsonl"
+
+# Commit barriers guard against a host that died mid-save: survivors
+# must notice within a bounded wait and take the checkpoint-and-exit
+# path instead of hanging. Default sized for object-store flush tails.
+DEFAULT_BARRIER_TIMEOUT = 600.0
+
+
+class CoordinationError(RuntimeError):
+    """Base class for coordination failures."""
+
+
+class BarrierTimeout(CoordinationError):
+    """A cross-host barrier (or gather) missed its deadline — some host
+    is dead or wedged. The surviving caller should checkpoint locally
+    and exit cleanly rather than retry into a hung world."""
+
+
+class ConsensusError(CoordinationError):
+    """Hosts could not agree on a restore step (disjoint valid sets or
+    a broadcast/decision mismatch) — restarting blindly would build a
+    divergent world, so this raises before any jitted state is used."""
+
+
+# -- step ledger --------------------------------------------------------------
+
+class StepLedger:
+    """Append-only `ledger.jsonl` beside the checkpoints: the external
+    source of truth for which steps are COMMITTED (restorable).
+
+    Entry format (one JSON object per line):
+        {"kind": "commit", "step": 400, "world": 16, "time": ...}
+        {"kind": "invalidate", "step": 400, "reason": "...", "time": ...}
+        {"kind": "note", "detail": "...", "time": ...}
+
+    Only process 0 writes (`record_*`); every host reads. Local writes
+    are flushed + fsync'd per entry so a committed step survives a host
+    crash immediately after the commit barrier; object-store paths
+    (`gs://...`) go through epath with per-object atomicity instead.
+    Reads tolerate a truncated trailing line (crash mid-append).
+    """
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self._remote = "://" in directory
+        if self._remote:
+            self.path = directory.rstrip("/") + "/" + LEDGER_FILENAME
+        else:
+            self.path = os.path.join(directory, LEDGER_FILENAME)
+
+    def exists(self) -> bool:
+        if self._remote:
+            from etils import epath
+            return epath.Path(self.path).exists()
+        return os.path.exists(self.path)
+
+    def _read_text(self) -> str:
+        if self._remote:
+            from etils import epath
+            p = epath.Path(self.path)
+            return p.read_text() if p.exists() else ""
+        if not os.path.exists(self.path):
+            return ""
+        with open(self.path, "r", encoding="utf-8") as f:
+            return f.read()
+
+    def entries(self) -> List[Dict[str, object]]:
+        """All parseable entries; a truncated trailing line (torn write)
+        is skipped, not fatal — the entry it would have recorded never
+        reached the ack barrier, so dropping it is the safe reading."""
+        out: List[Dict[str, object]] = []
+        for line in self._read_text().splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entry = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(entry, dict):
+                out.append(entry)
+        return out
+
+    def committed_steps(self) -> List[int]:
+        """Sorted steps with a commit entry and no later invalidate."""
+        live: Dict[int, bool] = {}
+        for e in self.entries():
+            kind, step = e.get("kind"), e.get("step")
+            if not isinstance(step, int):
+                continue
+            if kind == "commit":
+                live[step] = True
+            elif kind == "invalidate":
+                live[step] = False
+        return sorted(s for s, ok in live.items() if ok)
+
+    def is_committed(self, step: int) -> bool:
+        return step in self.committed_steps()
+
+    def record_commit(self, step: int, world_size: int,
+                      extra: Optional[Dict[str, object]] = None) -> None:
+        entry = {"kind": "commit", "step": int(step),
+                 "world": int(world_size), "time": time.time()}
+        if extra:
+            entry.update(extra)
+        self._append(entry)
+
+    def record_invalidate(self, step: int, reason: str = "") -> None:
+        self._append({"kind": "invalidate", "step": int(step),
+                      "reason": reason, "time": time.time()})
+
+    def record_note(self, detail: str) -> None:
+        self._append({"kind": "note", "detail": detail, "time": time.time()})
+
+    def _append(self, entry: Dict[str, object]) -> None:
+        line = json.dumps(entry)
+        if self._remote:
+            # object stores have no append; read-modify-write the whole
+            # object (single writer: process 0 only, so no lost updates)
+            from etils import epath
+            p = epath.Path(self.path)
+            p.write_text(self._read_text() + line + "\n")
+            return
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(self.path, "a", encoding="utf-8") as f:
+            f.write(line + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+
+# -- transports ---------------------------------------------------------------
+
+class Transport:
+    """World communication used by the coordinator. Implementations
+    provide a timeout-capable barrier plus small-JSON gather/broadcast;
+    every operation either completes on ALL members or raises
+    BarrierTimeout on the survivors within the deadline."""
+
+    process_index: int = 0
+    process_count: int = 1
+
+    def barrier(self, name: str, timeout: float) -> None:
+        raise NotImplementedError
+
+    def allgather_json(self, name: str, obj, timeout: float) -> List:
+        raise NotImplementedError
+
+    def broadcast_json(self, name: str, obj, timeout: float):
+        """Process 0's `obj` to everyone (non-0 callers' obj is ignored)."""
+        raise NotImplementedError
+
+
+class _InMemoryWorld:
+    """Shared state behind a set of InMemoryTransports (one per
+    simulated host, usually one thread each)."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self._cond = threading.Condition()
+        self._store: Dict[str, object] = {}
+        self._arrived: Dict[str, set] = {}
+        self._released: set = set()
+
+    def barrier(self, name: str, rank: int, timeout: float) -> None:
+        with self._cond:
+            self._arrived.setdefault(name, set()).add(rank)
+            if len(self._arrived[name]) >= self.n:
+                self._released.add(name)
+                self._cond.notify_all()
+            elif not self._cond.wait_for(
+                    lambda: name in self._released, timeout):
+                raise BarrierTimeout(
+                    f"barrier {name!r}: {len(self._arrived[name])}/{self.n} "
+                    f"arrived within {timeout}s")
+
+    def put(self, key: str, value) -> None:
+        with self._cond:
+            self._store[key] = value
+            self._cond.notify_all()
+
+    def get(self, key: str, timeout: float):
+        with self._cond:
+            if not self._cond.wait_for(lambda: key in self._store, timeout):
+                raise BarrierTimeout(
+                    f"key {key!r} not produced within {timeout}s")
+            return self._store[key]
+
+
+class InMemoryTransport(Transport):
+    """Single-process transport: a world of N members sharing one
+    `_InMemoryWorld` (threads in tests; N=1 for plain single-host runs).
+    Exercises the exact coordinator protocol on CPU without
+    `jax.distributed`."""
+
+    def __init__(self, world: _InMemoryWorld, rank: int):
+        self._world = world
+        self.process_index = rank
+        self.process_count = world.n
+
+    @classmethod
+    def make_world(cls, n: int) -> List["InMemoryTransport"]:
+        world = _InMemoryWorld(n)
+        return [cls(world, i) for i in range(n)]
+
+    def barrier(self, name: str, timeout: float) -> None:
+        self._world.barrier(name, self.process_index, timeout)
+
+    def allgather_json(self, name: str, obj, timeout: float) -> List:
+        # json round-trip deliberately mirrors the distributed backend:
+        # payloads must be serializable there too
+        self._world.put(f"ag/{name}/{self.process_index}", json.dumps(obj))
+        deadline = time.monotonic() + timeout
+        out = []
+        for j in range(self.process_count):
+            remaining = max(deadline - time.monotonic(), 0.001)
+            out.append(json.loads(self._world.get(f"ag/{name}/{j}",
+                                                  remaining)))
+        return out
+
+    def broadcast_json(self, name: str, obj, timeout: float):
+        if self.process_index == 0:
+            self._world.put(f"bc/{name}", json.dumps(obj))
+            return obj
+        return json.loads(self._world.get(f"bc/{name}", timeout))
+
+
+def _is_deadline_error(e: Exception) -> bool:
+    text = str(e)
+    return ("DEADLINE_EXCEEDED" in text or "deadline" in text.lower()
+            or isinstance(e, TimeoutError))
+
+
+class JaxDistributedTransport(Transport):
+    """Real multi-host backend over the `jax.distributed` coordination
+    service: `wait_at_barrier` gives barriers with genuine deadlines
+    (unlike device collectives, which hang forever when a participant
+    is gone), and the distributed KV store carries the small JSON
+    payloads (step sets, decisions)."""
+
+    def __init__(self, namespace: str = "flaxdiff.coord"):
+        import jax
+        from jax._src import distributed
+        client = getattr(distributed.global_state, "client", None)
+        if client is None:
+            raise CoordinationError(
+                "jax.distributed is not initialized — call "
+                "jax.distributed.initialize() before building a "
+                "JaxDistributedTransport (single-host runs should use "
+                "InMemoryTransport.make_world(1)[0] instead)")
+        self._client = client
+        self._ns = namespace
+        self.process_index = jax.process_index()
+        self.process_count = jax.process_count()
+
+    def barrier(self, name: str, timeout: float) -> None:
+        try:
+            self._client.wait_at_barrier(f"{self._ns}/{name}",
+                                         int(timeout * 1000))
+        except Exception as e:  # noqa: BLE001 — backend raises
+            # XlaRuntimeError; only the deadline case is a crash signal
+            if _is_deadline_error(e):
+                raise BarrierTimeout(
+                    f"barrier {name!r} timed out after {timeout}s: "
+                    f"{e}") from e
+            raise
+
+    def allgather_json(self, name: str, obj, timeout: float) -> List:
+        key = f"{self._ns}/ag/{name}"
+        self._client.key_value_set(f"{key}/{self.process_index}",
+                                   json.dumps(obj))
+        deadline = time.monotonic() + timeout
+        out = []
+        for j in range(self.process_count):
+            remaining_ms = max(int((deadline - time.monotonic()) * 1000), 1)
+            try:
+                out.append(json.loads(
+                    self._client.blocking_key_value_get(f"{key}/{j}",
+                                                        remaining_ms)))
+            except Exception as e:  # noqa: BLE001
+                if _is_deadline_error(e):
+                    raise BarrierTimeout(
+                        f"allgather {name!r}: process {j} did not "
+                        f"contribute within {timeout}s: {e}") from e
+                raise
+        return out
+
+    def broadcast_json(self, name: str, obj, timeout: float):
+        key = f"{self._ns}/bc/{name}"
+        if self.process_index == 0:
+            self._client.key_value_set(key, json.dumps(obj))
+            return obj
+        try:
+            return json.loads(
+                self._client.blocking_key_value_get(key,
+                                                    int(timeout * 1000)))
+        except Exception as e:  # noqa: BLE001
+            if _is_deadline_error(e):
+                raise BarrierTimeout(
+                    f"broadcast {name!r}: no value from process 0 "
+                    f"within {timeout}s: {e}") from e
+            raise
+
+
+def default_transport() -> Transport:
+    """The right transport for this process: the jax.distributed backend
+    when a multi-process world is initialized, else a world-of-one
+    in-memory transport (coordination degenerates to local decisions
+    but runs the same code paths)."""
+    import jax
+    if jax.process_count() > 1:
+        return JaxDistributedTransport()
+    return InMemoryTransport.make_world(1)[0]
+
+
+# -- the protocol -------------------------------------------------------------
+
+class RestartCoordinator:
+    """Pod-consistent commit / restore / crash handling over a Transport.
+
+    Commit (two-phase): every host votes with the step it finished
+    writing (phase 1, a timed allgather = the "all wrote" barrier);
+    only a unanimous vote makes process 0 append the ledger entry
+    (phase 2), and an ack barrier orders the fsync'd entry before any
+    host proceeds. A host whose save failed votes None and the round
+    aborts — a step some host never wrote must not become restorable.
+
+    Restore (consensus): hosts exchange their locally-valid committed
+    step sets; the agreed step is the max of the intersection, computed
+    identically everywhere and cross-checked against process 0's
+    broadcast decision. Disjoint non-empty sets raise ConsensusError —
+    restoring anyway would build a divergent world.
+
+    Crash barriers: every wait carries `barrier_timeout`; a missed
+    deadline records a `barrier_timeout` event, marks the coordinator
+    `lost`, and invokes `on_lost` (elastic-re-admission hook — e.g.
+    request a relaunch with a replacement host). Once lost, further
+    commits are skipped locally (`commit_skipped` events) so the
+    checkpoint-and-exit path never re-enters a hung world.
+    """
+
+    def __init__(self, transport: Transport,
+                 barrier_timeout: float = DEFAULT_BARRIER_TIMEOUT,
+                 event_log: Optional[EventLog] = None,
+                 on_lost: Optional[Callable[[str], None]] = None):
+        self.transport = transport
+        self.barrier_timeout = barrier_timeout
+        self.on_lost = on_lost
+        self.lost = False
+        self._event_log = event_log
+        self._seq = 0
+
+    @property
+    def _events(self) -> EventLog:
+        return (self._event_log if self._event_log is not None
+                else global_event_log())
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.transport.process_index == 0
+
+    def _next_seq(self) -> int:
+        seq, self._seq = self._seq, self._seq + 1
+        return seq
+
+    def _mark_lost(self, what: str, err: Exception) -> None:
+        self.lost = True
+        self._events.record("barrier_timeout", "coord.barrier",
+                            detail=f"{what}: {err}")
+        if self.on_lost is not None:
+            try:
+                self.on_lost(what)
+            except Exception:  # noqa: BLE001 — the hook must not mask
+                from .events import log
+                log.exception("on_lost hook failed")
+
+    def barrier(self, name: str,
+                timeout: Optional[float] = None) -> None:
+        """A named crash barrier: completes everywhere or raises
+        BarrierTimeout (marking the coordinator lost) on survivors."""
+        try:
+            self.transport.barrier(name, timeout if timeout is not None
+                                   else self.barrier_timeout)
+        except BarrierTimeout as e:
+            self._mark_lost(f"barrier {name!r}", e)
+            raise
+
+    # -- two-phase commit ----------------------------------------------------
+    def commit(self, step: Optional[int], ledger: StepLedger,
+               meta: Optional[Dict[str, object]] = None) -> Optional[int]:
+        """Commit `step` (the step this host finished writing; None if
+        its save failed/was skipped). Returns the committed step, or
+        None when the round aborted or there was nothing to commit."""
+        if self.lost:
+            self._events.record(
+                "commit_skipped", "ckpt.commit",
+                detail="coordination lost (earlier barrier timeout); "
+                       "local save remains uncommitted", step=step)
+            return None
+        seq = self._next_seq()
+        try:
+            votes = self.transport.allgather_json(
+                f"commit.{seq}", step, self.barrier_timeout)
+        except BarrierTimeout as e:
+            self._mark_lost(f"commit vote for step {step}", e)
+            raise
+        if all(v is None for v in votes):
+            return None                       # nothing to commit anywhere
+        if any(v != step for v in votes):
+            # some host failed its save (None) or wrote a different
+            # step: the step is not globally durable — abort, no entry
+            self._events.record(
+                "commit_aborted", "ckpt.commit",
+                detail=f"non-unanimous votes {votes}; step stays "
+                       f"uncommitted", step=step)
+            return None
+        if self.is_coordinator:
+            ledger.record_commit(step, self.transport.process_count,
+                                 extra=meta)
+        try:
+            # ack barrier: the fsync'd ledger entry happens-before any
+            # host treats the step as restorable
+            self.transport.barrier(f"commit.{seq}.ack",
+                                   self.barrier_timeout)
+        except BarrierTimeout as e:
+            self._mark_lost(f"commit ack for step {step}", e)
+            raise
+        self._events.record("commit", "ckpt.commit",
+                            detail=f"step {step} committed by "
+                                   f"{len(votes)} process(es)", step=step)
+        return step
+
+    # -- consensus restore ---------------------------------------------------
+    def consensus_restore_step(
+            self, local_valid_steps: Iterable[int]) -> Optional[int]:
+        """Agree on the one step every host restores: max of the
+        intersection of the hosts' locally-valid committed-step sets.
+        Returns None iff NO host has any valid step (cold start);
+        raises ConsensusError when hosts hold steps but share none."""
+        if self.lost:
+            raise CoordinationError(
+                "cannot run consensus restore: coordination lost")
+        local = sorted(set(int(s) for s in local_valid_steps))
+        seq = self._next_seq()
+        try:
+            sets = self.transport.allgather_json(
+                f"restore.{seq}", local, self.barrier_timeout)
+        except BarrierTimeout as e:
+            self._mark_lost("consensus restore gather", e)
+            raise
+        common = set(sets[0]).intersection(*map(set, sets[1:])) \
+            if sets else set()
+        chosen = max(common) if common else None
+        # process 0 broadcasts its decision; everyone computed the same
+        # thing from the same gathered sets, so a mismatch means broken
+        # transport or torn ledger views — fail before touching state
+        try:
+            decided = self.transport.broadcast_json(
+                f"restore.{seq}.decision", chosen, self.barrier_timeout)
+        except BarrierTimeout as e:
+            self._mark_lost("consensus restore decision", e)
+            raise
+        if decided != chosen:
+            raise ConsensusError(
+                f"restore decision diverged: coordinator chose {decided}, "
+                f"this host computed {chosen} (local set {local}, "
+                f"gathered {sets})")
+        if decided is None and any(sets):
+            raise ConsensusError(
+                f"hosts hold checkpoints but share no committed step "
+                f"(gathered sets {sets}); refusing to restore a "
+                f"divergent world")
+        if decided is not None:
+            self._events.record(
+                "consensus_restore", "ckpt.restore",
+                detail=f"world of {len(sets)} agreed on step {decided} "
+                       f"(set sizes {[len(s) for s in sets]})",
+                step=decided)
+        return decided

@@ -31,6 +31,14 @@ log = logging.getLogger("flaxdiff_tpu.resilience")
 #   starvation         data loader yielded a fallback (repeated) batch
 #   fault_injected     a deterministic fault-plan site fired
 #   preempt            SIGTERM received; checkpointing and exiting
+#   commit             a checkpoint step passed the two-phase commit
+#   commit_aborted     non-unanimous commit votes; step stays uncommitted
+#   commit_skipped     coordination lost; save left uncommitted locally
+#   consensus_restore  the world agreed on one restore step
+#   barrier_timeout    a crash barrier missed its deadline (peer dead)
+#   restored           fit resumed from a checkpoint at start
+#   cold_start         restore_at_start found nothing; training fresh
+#   warning            a requested safety feature could not be armed
 
 
 @dataclasses.dataclass(frozen=True)

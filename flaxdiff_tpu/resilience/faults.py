@@ -15,6 +15,9 @@ Known sites (the framework's barriers; plans may name new ones freely):
     data.stall    loader worker: injects a sleep (wedged-loader chaos)
     step.nan      DiffusionTrainer.fit: poisons the next loss readback
     host.sigterm  DiffusionTrainer.fit: SIGTERMs the process at a step
+    coord.local_valid  Checkpointer.locally_valid_steps: drops the
+                  newest step from THIS host's consensus-restore input
+                  (asymmetric-corruption chaos; arm on one host only)
 
 A plan is JSON-serializable and env-drivable::
 

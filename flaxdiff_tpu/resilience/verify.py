@@ -33,9 +33,32 @@ class StepReport:
     n_bytes: int = 0
     n_leaves: Optional[int] = None          # deep only
     nonfinite_leaves: List[str] = dataclasses.field(default_factory=list)
+    # ledger commit status (None = no ledger present / not annotated).
+    # Validity and commitment are orthogonal: an intact-but-uncommitted
+    # step is still not restorable under coordination.
+    committed: Optional[bool] = None
 
     def as_dict(self) -> Dict[str, object]:
         return dataclasses.asdict(self)
+
+
+def annotate_ledger(directory: str, reports: List[StepReport]) -> Dict:
+    """Attach per-step ledger commit status to `reports` and return a
+    summary dict ({present, path, committed_steps, entries}) for the
+    fleet-debugging CLI. With no ledger file every `committed` stays
+    None (pre-coordination checkpoint dir)."""
+    from .coordination import StepLedger
+    ledger = StepLedger(directory)
+    if not ledger.exists():
+        return {"present": False, "path": ledger.path,
+                "committed_steps": [], "entries": 0}
+    committed = set(ledger.committed_steps())
+    for r in reports:
+        if r.step >= 0:
+            r.committed = r.step in committed
+    return {"present": True, "path": ledger.path,
+            "committed_steps": sorted(committed),
+            "entries": len(ledger.entries())}
 
 
 def _step_dir(directory: str, step: int) -> str:

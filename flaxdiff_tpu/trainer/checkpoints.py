@@ -16,6 +16,8 @@ import orbax.checkpoint as ocp
 
 from ..resilience import events as _events
 from ..resilience import faults as _faults
+from ..resilience.coordination import (ConsensusError, RestartCoordinator,
+                                       StepLedger)
 from ..resilience.retry import RetryError, RetryPolicy
 from ..typing import PyTree
 
@@ -41,12 +43,25 @@ class Checkpointer:
     `last_save_result` exposes the outcome of the most recent `save`
     ("started" | "skipped_exists" | "failed") so the fit loop does not
     count a skip/failure as a successful save.
+
+    Coordinated restart (resilience/coordination.py): with a
+    `coordinator`, saves become two-phase — `save` starts the async
+    write as before and `commit_pending` later runs the cross-host
+    commit round (all-wrote barrier -> fsync'd `ledger.jsonl` entry
+    by process 0 -> ack barrier). Only COMMITTED steps are restorable:
+    `latest_step` and `restore` consult the ledger, and a coordinated
+    `restore` runs a consensus round so every host restores exactly
+    the same step (divergence raises instead of walking back locally).
+    `use_ledger=True` enables the ledger without a coordinator
+    (single-host runs that still want commit semantics).
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  save_interval_steps: int = 1,
                  save_retry: Optional[RetryPolicy] = DEFAULT_SAVE_RETRY,
-                 event_log: Optional[_events.EventLog] = None):
+                 event_log: Optional[_events.EventLog] = None,
+                 coordinator: Optional[RestartCoordinator] = None,
+                 use_ledger: Optional[bool] = None):
         directory = os.path.abspath(os.path.expanduser(directory)) \
             if "://" not in directory else directory
         self._mgr = ocp.CheckpointManager(
@@ -59,6 +74,12 @@ class Checkpointer:
         )
         self._save_retry = save_retry
         self._event_log = event_log
+        self._coordinator = coordinator
+        if use_ledger is None:
+            use_ledger = coordinator is not None
+        self._ledger = StepLedger(str(self._mgr.directory)) \
+            if use_ledger else None
+        self._pending_commit: Optional[int] = None
         self.last_save_result: str = "none"
 
     @property
@@ -115,7 +136,86 @@ class Checkpointer:
                                 detail=repr(e), step=step)
             return False
         self.last_save_result = "started" if started else "skipped_exists"
+        if started:
+            # two-phase commit, phase 0: remember what commit_pending
+            # must flush + vote on (overwrites an earlier never-committed
+            # pending step — only the newest write can become restorable)
+            self._pending_commit = step
         return bool(started)
+
+    # -- two-phase commit ----------------------------------------------------
+    @property
+    def coordinated(self) -> bool:
+        return self._coordinator is not None
+
+    @property
+    def coordinator(self) -> Optional[RestartCoordinator]:
+        return self._coordinator
+
+    @property
+    def ledger(self) -> Optional[StepLedger]:
+        return self._ledger
+
+    def commit_pending(self) -> Optional[int]:
+        """Phase 1+2 of the two-phase commit for the last started save:
+        flush the async write, verify it landed (PR-1 shallow integrity
+        check), then run the cross-host commit round — the step becomes
+        restorable only after every process confirmed its write and
+        process 0's ledger entry is fsync'd behind the ack barrier.
+
+        Without a ledger this is a no-op returning the pending step.
+        All hosts must call this at the same points (it is a collective
+        when coordinated); a host whose save failed votes None and the
+        round aborts with a `commit_aborted` event. Raises
+        BarrierTimeout when a peer died mid-round — the caller should
+        take the checkpoint-and-exit path, not retry."""
+        step, self._pending_commit = self._pending_commit, None
+        if self._ledger is None:
+            return step
+        if step is not None:
+            self.wait_until_finished()
+            from ..resilience.verify import verify_step
+            report = verify_step(str(self._mgr.directory), step)
+            if not report.ok:
+                self._events.record(
+                    "commit_aborted", "ckpt.commit",
+                    detail=f"local write of step {step} failed "
+                           f"verification: {report.errors}", step=step)
+                step = None
+        if self._coordinator is None:
+            # single-host ledger: local write is the whole world
+            if step is not None:
+                self._ledger.record_commit(step, world_size=1)
+                self._events.record("commit", "ckpt.commit",
+                                    detail=f"step {step} committed "
+                                           "(single host)", step=step)
+            return step
+        return self._coordinator.commit(step, self._ledger)
+
+    def committed_steps(self):
+        """Steps both on disk and recorded in the ledger (ledger mode);
+        all on-disk steps otherwise."""
+        steps = set(self._mgr.all_steps())
+        if self._ledger is not None and self._ledger.exists():
+            steps &= set(self._ledger.committed_steps())
+        return sorted(steps)
+
+    def locally_valid_steps(self, deep: bool = False):
+        """THIS host's restorable-step set: committed (ledger mode) and
+        passing the PR-1 integrity check — the input each host brings
+        to the consensus-restore round. A directory with checkpoints
+        but no ledger file (pre-coordination run) treats every intact
+        step as valid, so legacy checkpoints stay resumable."""
+        from ..resilience.verify import verify_step
+        directory = str(self._mgr.directory)
+        candidates = self.committed_steps()
+        valid = [s for s in candidates
+                 if verify_step(directory, s, deep=deep).ok]
+        # chaos site: simulate corruption OBSERVED by this host only
+        # (e.g. a bad local read path) — drops the newest valid step
+        if valid and _faults.check("coord.local_valid"):
+            valid.pop()
+        return valid
 
     def restore(self, abstract_state: PyTree,
                 step: Optional[int] = None,
@@ -127,10 +227,20 @@ class Checkpointer:
         With `fallback` (and no explicit `step`), a corrupt/incomplete
         newest checkpoint walks back to the next older step instead of
         killing the run; each skip records a `fallback_restore` event.
-        An explicit `step` is restored exactly or raises."""
+        An explicit `step` is restored exactly or raises.
+
+        Ledger mode restricts candidates to COMMITTED steps (a save
+        some host never finished must not be restored). A coordinated
+        restore replaces the local walk-back entirely with a consensus
+        round: every host restores exactly the agreed step, and any
+        disagreement raises (ConsensusError) before the restored state
+        is used — N hosts silently restoring N different steps is the
+        failure mode this exists to kill."""
         if step is not None:
             return self._restore_one(abstract_state, step)
-        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if self._coordinator is not None:
+            return self._consensus_restore(abstract_state)
+        steps = sorted(self.committed_steps(), reverse=True)
         if not steps:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.directory}")
@@ -180,6 +290,27 @@ class Checkpointer:
                     state=ocp.args.StandardRestore(abstract_state)))
         return restored["state"], (restored.get("meta") or {})
 
+    def _consensus_restore(self, abstract_state: PyTree) -> tuple:
+        """Coordinated restore: gather this host's valid committed steps,
+        agree on the max common step, restore EXACTLY that step. No
+        local walk-back — a read failure here raises, because falling
+        back unilaterally is precisely the divergence consensus
+        prevents."""
+        local = self.locally_valid_steps()
+        chosen = self._coordinator.consensus_restore_step(local)
+        if chosen is None:
+            # uniform cold start: no host holds any restorable step
+            raise FileNotFoundError(
+                f"no committed restorable checkpoint under "
+                f"{self.directory} on any host")
+        if chosen not in local:
+            # intersection ⊆ local makes this unreachable through the
+            # coordinator; guards a buggy/foreign transport
+            raise ConsensusError(
+                f"agreed step {chosen} is not in this host's valid set "
+                f"{local}")
+        return self._restore_one(abstract_state, chosen)
+
     def restore_to_host(self, step: Optional[int] = None) -> tuple:
         """Restore (state, meta) as HOST NUMPY arrays, topology-free.
 
@@ -223,6 +354,12 @@ class Checkpointer:
         return restored["state"], (restored.get("meta") or {})
 
     def latest_step(self) -> Optional[int]:
+        """Newest RESTORABLE step: in ledger mode the newest committed
+        step (an uncommitted write on disk is not restorable), else the
+        newest on disk."""
+        if self._ledger is not None and self._ledger.exists():
+            steps = self.committed_steps()
+            return steps[-1] if steps else None
         return self._mgr.latest_step()
 
     def all_steps(self):

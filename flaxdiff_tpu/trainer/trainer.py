@@ -73,6 +73,12 @@ class TrainerConfig:
     # The first step is exempt (jit compile can legitimately exceed it).
     watchdog_timeout: Optional[float] = None
     watchdog_action: str = "sigterm"
+    # Resume INSIDE fit, before the first step: with a coordinated
+    # checkpointer this is the consensus-restore round (every host
+    # restores the same committed step or fit raises before stepping);
+    # without one it is the ordinary fallback restore. A missing
+    # checkpoint is a cold start, not an error.
+    restore_at_start: bool = False
 
 
 class DiffusionTrainer:
@@ -326,6 +332,7 @@ class DiffusionTrainer:
         history: Dict[str, Any] = {"steps": [], "loss": [], "imgs_per_sec": [],
                                    "mfu": [], "preempted": False,
                                    "watchdog_fired": False,
+                                   "coordination_lost": False,
                                    "saves": {"started": 0,
                                              "skipped_exists": 0,
                                              "failed": 0}}
@@ -333,15 +340,48 @@ class DiffusionTrainer:
         fault_plan = _res_faults.active_plan()
         nan_pending = False     # step.nan fault armed for next loss read
 
+        # Resume-at-start: under coordination this is the consensus
+        # round — it must run BEFORE any step so a divergent world
+        # raises here, never trains. ConsensusError propagates.
+        if cfg.restore_at_start and self.checkpointer is not None:
+            try:
+                step0 = self.restore_checkpoint()
+                events.record("restored", "train.start",
+                              detail=f"resumed from step {step0}",
+                              step=step0)
+            except FileNotFoundError:
+                events.record("cold_start", "train.start",
+                              detail="no restorable checkpoint; "
+                                     "training from scratch")
+
         def count_save():
             res = (self.checkpointer.last_save_result
                    if self.checkpointer is not None else "none")
             if res in history["saves"]:
                 history["saves"][res] += 1
 
-        # SIGTERM -> finish the current step, checkpoint, return. Only the
-        # main thread may install handlers; elsewhere (e.g. fit driven
-        # from a worker thread) preemption safety is skipped silently.
+        def commit_save(final: bool = False) -> None:
+            """Two-phase-commit the save just dispatched (no-op without
+            a ledger). A BarrierTimeout means a peer died mid-round:
+            mark coordination lost in the history and stop — the final
+            local save still happens, uncommitted, on the
+            checkpoint-and-exit path instead of hanging in collectives."""
+            if self.checkpointer is None:
+                return
+            from ..resilience.coordination import BarrierTimeout
+            try:
+                self.checkpointer.commit_pending()
+            except BarrierTimeout:
+                # the coordinator recorded barrier_timeout and marked
+                # itself lost; later commits degrade to local skips
+                history["coordination_lost"] = True
+                if not final:
+                    stop["flag"] = True
+
+        # SIGTERM -> finish the current step, checkpoint, return. Only
+        # the main thread may install handlers; elsewhere (e.g. fit
+        # driven from a worker thread) preemption safety cannot arm —
+        # surfaced as a resilience warning, not a silent skip.
         import signal
         stop = {"flag": False}
         prev_handler = None
@@ -355,7 +395,12 @@ class DiffusionTrainer:
                 prev_handler = signal.signal(signal.SIGTERM, _on_term)
                 handler_installed = True
             except ValueError:
-                pass
+                events.record(
+                    "warning", "train.sigterm",
+                    detail="checkpoint_on_sigterm requested but the "
+                           "SIGTERM handler could not be installed "
+                           "(fit is not running on the main thread); "
+                           "preemption safety disabled for this run")
 
         # Heartbeat watchdog: turns a wedged step/loader into a clean
         # checkpoint-and-exit (resilience/watchdog.py). The "sigterm"
@@ -491,6 +536,7 @@ class DiffusionTrainer:
                     else:
                         self.save_checkpoint()
                         count_save()
+                        commit_save()
 
             # The final save can legitimately outlast the watchdog timeout
             # (sync flush of an async save) — stand the watchdog down
@@ -503,6 +549,7 @@ class DiffusionTrainer:
             # harmless re-mark of stop["flag"]), not the default action.
             self.save_checkpoint(force=True)
             count_save()
+            commit_save(final=True)
         finally:
             if watchdog is not None:
                 watchdog.stop()

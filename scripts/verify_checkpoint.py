@@ -4,17 +4,21 @@
 A corrupt orbax step dir is listed by `all_steps()` like a good one and
 only fails at restore time — run this BEFORE pointing a pod job at a
 checkpoint directory, or after any run that logged `save_failed` /
-`fallback_restore` resilience events.
+`fallback_restore` / `commit_aborted` resilience events.
 
 Usage:
     python scripts/verify_checkpoint.py runs/ckpt              # latest step
     python scripts/verify_checkpoint.py runs/ckpt --all        # every step
     python scripts/verify_checkpoint.py runs/ckpt --step 400 --deep
     python scripts/verify_checkpoint.py runs/ckpt --json
+    python scripts/verify_checkpoint.py runs/ckpt --all-steps --json
 
 `--deep` additionally restores every leaf to host numpy (topology-free)
-and flags non-finite tensors. Exit code 0 iff every checked step is
-intact.
+and flags non-finite tensors. `--all-steps --json` is the fleet-debug
+mode for asymmetric corruption: run it on every host and diff — it
+prints ONE JSON object holding per-step validity plus the step-ledger
+commit status (docs/RESILIENCE.md), the exact inputs each host brings
+to a consensus restore. Exit code 0 iff every checked step is intact.
 """
 from __future__ import annotations
 
@@ -33,6 +37,10 @@ def main(argv=None) -> int:
                     help="check this step only (default: latest)")
     ap.add_argument("--all", action="store_true", dest="all_steps",
                     help="check every step dir")
+    ap.add_argument("--all-steps", action="store_true", dest="combined",
+                    help="check every step AND report ledger commit "
+                         "status; with --json, one combined object "
+                         "(fleet-wide asymmetric-corruption debugging)")
     ap.add_argument("--deep", action="store_true",
                     help="restore every leaf to host numpy and check "
                          "finiteness (slower; needs jax+orbax)")
@@ -40,23 +48,44 @@ def main(argv=None) -> int:
                     help="machine-readable report on stdout")
     args = ap.parse_args(argv)
 
-    from flaxdiff_tpu.resilience.verify import verify_checkpoint
+    from flaxdiff_tpu.resilience.verify import (annotate_ledger,
+                                                verify_checkpoint)
     reports = verify_checkpoint(args.directory, step=args.step,
-                                deep=args.deep, all_steps=args.all_steps)
+                                deep=args.deep,
+                                all_steps=args.all_steps or args.combined)
+    ledger = annotate_ledger(args.directory, reports)
+    ok = all(r.ok and not r.nonfinite_leaves for r in reports)
 
-    if args.as_json:
+    if args.as_json and args.combined:
+        # one object per host: diff these across the fleet to localize
+        # which host disagrees about which step
+        print(json.dumps({
+            "directory": args.directory,
+            "ok": ok,
+            "ledger": ledger,
+            "steps": [r.as_dict() for r in reports],
+        }, indent=2))
+    elif args.as_json:
         print(json.dumps([r.as_dict() for r in reports], indent=2))
     else:
+        if args.combined:
+            if ledger["present"]:
+                print(f"ledger: {len(ledger['committed_steps'])} committed "
+                      f"step(s) {ledger['committed_steps']} "
+                      f"({ledger['entries']} entries)")
+            else:
+                print("ledger: none (pre-coordination checkpoint dir)")
         for r in reports:
             status = "OK " if r.ok else "BAD"
             extra = f", {r.n_leaves} leaves" if r.n_leaves is not None else ""
+            if r.committed is not None:
+                extra += (", committed" if r.committed else ", UNCOMMITTED")
             print(f"[{status}] step {r.step}: {r.n_files} files, "
                   f"{r.n_bytes} bytes{extra}")
             for err in r.errors:
                 print(f"      - {err}")
             for leaf in r.nonfinite_leaves:
                 print(f"      - non-finite values in {leaf}")
-    ok = all(r.ok and not r.nonfinite_leaves for r in reports)
     return 0 if ok else 1
 
 

@@ -14,9 +14,21 @@ simple_trainer.py:43-65, dataloaders.py:297-305):
   then, in a FRESH 2-process run:
     -> sharded restore onto the same topology + one more step.
 
+Coordinated-restart phases (resilience/coordination.py over the REAL
+jax.distributed coordination service):
+  train_coord           train 5 steps; two-phase-commit steps 2 and 4
+                        (ledger.jsonl); save step 5 WITHOUT committing
+  restore_coord_asym    no on-disk damage; process 1 arms the
+                        coord.local_valid chaos site so ITS valid set
+                        drops step 4 — consensus must pick 2 everywhere
+  restore_coord_corrupt process 1 truncates the newest committed step
+                        (4) on disk; both processes must agree on 2 and
+                        never choose the uncommitted step 5
+
 Prints one JSON line ("RESULT {...}") with the per-step losses; the
 driver asserts both processes report identical losses (the global step
-is one program — divergence means broken global assembly or collectives).
+is one program — divergence means broken global assembly or collectives)
+and, for the coordinated phases, the SAME restored step.
 """
 import json
 import os
@@ -25,7 +37,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_trainer(ckpt_dir):
+def build_trainer(ckpt_dir, coordinated=False):
     import flax.linen as nn
     import jax
     import jax.numpy as jnp
@@ -48,6 +60,17 @@ def build_trainer(ckpt_dir):
     model = TinyUnet()
     mesh = create_mesh(axes={"data": 2, "fsdp": 4})
 
+    coordinator = None
+    max_to_keep = 2
+    if coordinated:
+        from flaxdiff_tpu.resilience.coordination import (
+            JaxDistributedTransport, RestartCoordinator)
+        # short deadline: a genuinely hung peer must fail the phase,
+        # not outlive the test driver's own timeout
+        coordinator = RestartCoordinator(JaxDistributedTransport(),
+                                         barrier_timeout=120.0)
+        max_to_keep = 8      # keep every step the phases reason about
+
     return DiffusionTrainer(
         apply_fn=lambda p, x, t, c: model.apply({"params": p}, x, t, c),
         init_fn=lambda key: model.init(
@@ -58,7 +81,8 @@ def build_trainer(ckpt_dir):
         mesh=mesh,
         config=TrainerConfig(normalize=True, keep_best_state=False,
                              checkpoint_on_sigterm=False),
-        checkpointer=Checkpointer(ckpt_dir, max_to_keep=2),
+        checkpointer=Checkpointer(ckpt_dir, max_to_keep=max_to_keep,
+                                  coordinator=coordinator),
     ), mesh
 
 
@@ -87,10 +111,22 @@ def main():
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # cross-process CPU collectives need an explicit implementation on
+    # current jaxlib (without it every multi-process computation fails
+    # with "Multiprocess computations aren't implemented on the CPU
+    # backend"); gloo is the one compiled into stock jaxlib
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(coordinator_address=f"localhost:{port}",
                                num_processes=2, process_id=proc_id)
     assert jax.process_count() == 2
     assert jax.device_count() == 8 and jax.local_device_count() == 4
+
+    result = {}
+    if phase.startswith(("train_coord", "restore_coord")):
+        run_coordinated_phase(phase, proc_id, ckpt_dir, result)
+        print("RESULT " + json.dumps({"proc": proc_id, "phase": phase,
+                                      **result}), flush=True)
+        return
 
     trainer, mesh = build_trainer(ckpt_dir)
     losses = []
@@ -118,6 +154,69 @@ def main():
 
     print("RESULT " + json.dumps({"proc": proc_id, "phase": phase,
                                   "losses": losses}), flush=True)
+
+
+def run_coordinated_phase(phase, proc_id, ckpt_dir, result):
+    """Coordinated-restart phases: two-phase commits into the step
+    ledger, then consensus restores under (simulated-)asymmetric
+    corruption — the full save -> commit -> corrupt -> consensus story
+    over real jax.distributed."""
+    import jax
+
+    from flaxdiff_tpu.resilience import FaultPlan, FaultSpec, install_plan
+    from flaxdiff_tpu.resilience.verify import corrupt_step_dir
+
+    if phase == "restore_coord_asym":
+        # ONE host's view of the newest committed step goes bad (the
+        # chaos stand-in for a local read path serving garbage): its
+        # locally-valid set must shrink, and consensus must converge on
+        # the best step EVERY host still trusts
+        if proc_id == 1:
+            install_plan(FaultPlan(
+                [FaultSpec("coord.local_valid", at=(1,), error="flag",
+                           times=1)]))
+
+    trainer, mesh = build_trainer(ckpt_dir, coordinated=True)
+    ck = trainer.checkpointer
+    losses = []
+
+    if phase == "train_coord":
+        it = data_iterator(global_batch=8)
+        for i in range(5):
+            gb = trainer.put_batch(next(it))
+            losses.append(float(jax.device_get(trainer.train_step(gb))))
+            if (i + 1) in (2, 4):
+                assert trainer.save_checkpoint()
+                committed = ck.commit_pending()
+                assert committed == i + 1, (committed, i + 1)
+        # an UNCOMMITTED newest step: written everywhere but never taken
+        # through the commit round — must never be chosen by a restore
+        assert trainer.save_checkpoint()
+        ck.wait_until_finished()
+        result.update(losses=losses,
+                      committed=ck.ledger.committed_steps(),
+                      all_steps=ck.all_steps(),
+                      latest=ck.latest_step())
+    elif phase in ("restore_coord_asym", "restore_coord_corrupt"):
+        if phase == "restore_coord_corrupt" and proc_id == 1:
+            # asymmetric damage, performed by ONE host: truncate the
+            # newest committed step (shallow verify catches zero-byte
+            # files, so every host's valid set drops it)
+            corrupt_step_dir(ckpt_dir, 4, mode="truncate")
+        # hold everyone until the damage/fault arming is in place, so
+        # no host races its validity scan past an intact step 4
+        ck.coordinator.transport.barrier(f"{phase}.armed", 60.0)
+        restored = trainer.restore_checkpoint()
+        # prove the restored world actually trains (jitted state is
+        # consistent across processes)
+        it = data_iterator(global_batch=8)
+        gb = trainer.put_batch(next(it))
+        losses.append(float(jax.device_get(trainer.train_step(gb))))
+        result.update(losses=losses, restored=restored,
+                      valid_after=ck.locally_valid_steps(),
+                      step_after=int(jax.device_get(trainer.state.step)))
+    else:
+        raise SystemExit(f"unknown coordinated phase {phase}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,422 @@
+"""Coordinated multi-host restart (resilience/coordination.py) on CPU:
+step-ledger commits, two-phase commit rounds, consensus restore, and
+crash-barrier timeouts — all over the in-memory transport, so every
+consensus path runs single-process in tier-1. The same protocol over
+REAL `jax.distributed` is covered by tests/test_multiprocess.py.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flaxdiff_tpu import resilience as R
+from flaxdiff_tpu.trainer.checkpoints import Checkpointer
+
+
+def _coordinators(n, timeout=5.0, event_log=None):
+    return [R.RestartCoordinator(t, barrier_timeout=timeout,
+                                 event_log=event_log)
+            for t in R.InMemoryTransport.make_world(n)]
+
+
+def _both(fn0, fn1):
+    """Run two ranks concurrently; re-raise the first failure."""
+    out, errs = [None, None], []
+
+    def run(i, fn):
+        try:
+            out[i] = fn()
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    t = threading.Thread(target=run, args=(1, fn1))
+    t.start()
+    run(0, fn0)
+    t.join()
+    if errs:
+        raise errs[0]
+    return out
+
+
+# -- step ledger --------------------------------------------------------------
+
+def test_ledger_roundtrip_and_torn_tail(tmp_path):
+    led = R.StepLedger(str(tmp_path))
+    led.record_commit(2, world_size=4)
+    led.record_commit(4, world_size=4, extra={"note": "post-resume"})
+    led.record_invalidate(2, reason="operator")
+    led.record_note("relaunch requested")
+    assert led.committed_steps() == [4]
+    assert led.is_committed(4) and not led.is_committed(2)
+    # a crash mid-append leaves a torn trailing line: reads must drop
+    # it (the entry never reached the ack barrier) and keep the rest
+    with open(led.path, "a") as f:
+        f.write('{"kind": "commit", "step": 6, "wo')
+    assert R.StepLedger(str(tmp_path)).committed_steps() == [4]
+
+
+def test_ledger_absent_reads_empty(tmp_path):
+    led = R.StepLedger(str(tmp_path / "nowhere"))
+    assert not led.exists()
+    assert led.committed_steps() == []
+    assert led.entries() == []
+
+
+# -- transport / crash barriers ----------------------------------------------
+
+def test_inmemory_barrier_syncs_and_times_out():
+    t0, t1 = R.InMemoryTransport.make_world(2)
+    assert _both(lambda: t0.barrier("b1", 5.0),
+                 lambda: t1.barrier("b1", 5.0)) == [None, None]
+    # a missing member turns into BarrierTimeout on the survivor,
+    # within the deadline — never an indefinite hang
+    start = time.monotonic()
+    with pytest.raises(R.BarrierTimeout):
+        t0.barrier("b2", 0.3)
+    assert time.monotonic() - start < 3.0
+
+
+def test_inmemory_allgather_and_broadcast():
+    t0, t1 = R.InMemoryTransport.make_world(2)
+    got = _both(lambda: t0.allgather_json("g", [2, 4], 5.0),
+                lambda: t1.allgather_json("g", [2], 5.0))
+    assert got == [[[2, 4], [2]], [[2, 4], [2]]]
+    got = _both(lambda: t0.broadcast_json("d", 7, 5.0),
+                lambda: t1.broadcast_json("d", None, 5.0))
+    assert got == [7, 7]
+
+
+# -- two-phase commit ---------------------------------------------------------
+
+def test_commit_unanimous_writes_one_ledger_entry(tmp_path):
+    ev = R.EventLog("t")
+    c0, c1 = _coordinators(2, event_log=ev)
+    led = R.StepLedger(str(tmp_path))
+    assert _both(lambda: c0.commit(4, led),
+                 lambda: c1.commit(4, led)) == [4, 4]
+    assert led.committed_steps() == [4]
+    # only the coordinator (rank 0) wrote; exactly one commit entry
+    assert sum(e["kind"] == "commit" for e in led.entries()) == 1
+    assert led.entries()[0]["world"] == 2
+    assert ev.count("commit", "ckpt.commit") == 2      # both ranks record
+
+
+def test_commit_aborts_on_non_unanimous_votes(tmp_path):
+    ev = R.EventLog("t")
+    c0, c1 = _coordinators(2, event_log=ev)
+    led = R.StepLedger(str(tmp_path))
+    # rank 1's save failed (votes None): the step must NOT become
+    # restorable anywhere
+    assert _both(lambda: c0.commit(6, led),
+                 lambda: c1.commit(None, led)) == [None, None]
+    assert led.committed_steps() == []
+    assert ev.count("commit_aborted", "ckpt.commit") >= 1
+
+
+def test_commit_all_none_is_quiet_noop(tmp_path):
+    ev = R.EventLog("t")
+    c0, c1 = _coordinators(2, event_log=ev)
+    led = R.StepLedger(str(tmp_path))
+    assert _both(lambda: c0.commit(None, led),
+                 lambda: c1.commit(None, led)) == [None, None]
+    assert ev.count("commit_aborted") == 0
+
+
+def test_commit_timeout_marks_lost_and_later_commits_skip(tmp_path):
+    ev = R.EventLog("t")
+    lost = []
+    c0 = R.RestartCoordinator(R.InMemoryTransport.make_world(2)[0],
+                              barrier_timeout=0.3, event_log=ev,
+                              on_lost=lost.append)
+    led = R.StepLedger(str(tmp_path))
+    # the peer is dead: the vote gather misses its deadline
+    with pytest.raises(R.BarrierTimeout):
+        c0.commit(4, led)
+    assert c0.lost and lost          # elastic re-admission hook fired
+    assert ev.count("barrier_timeout", "coord.barrier") == 1
+    # once lost, commits degrade to fast local skips — the clean
+    # checkpoint-and-exit path must never re-enter a hung world
+    start = time.monotonic()
+    assert c0.commit(6, led) is None
+    assert time.monotonic() - start < 0.2
+    assert ev.count("commit_skipped", "ckpt.commit") == 1
+    assert led.committed_steps() == []
+
+
+# -- consensus restore --------------------------------------------------------
+
+def test_consensus_picks_max_common_step():
+    c0, c1 = _coordinators(2)
+    # host 1 locally lost step 4: the world agrees on 2
+    assert _both(lambda: c0.consensus_restore_step([2, 4]),
+                 lambda: c1.consensus_restore_step([2])) == [2, 2]
+
+
+def test_consensus_cold_start_is_none():
+    c0, c1 = _coordinators(2)
+    assert _both(lambda: c0.consensus_restore_step([]),
+                 lambda: c1.consensus_restore_step([])) == [None, None]
+
+
+def test_consensus_disjoint_sets_raise_divergence():
+    c0, c1 = _coordinators(2)
+    errs = []
+
+    def run(c, steps):
+        try:
+            c.consensus_restore_step(steps)
+        except R.ConsensusError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=run, args=(c1, [2]))
+    t.start()
+    run(c0, [4])
+    t.join()
+    # BOTH hosts refuse: restoring would build a divergent world
+    assert len(errs) == 2
+
+
+# -- ledger-aware Checkpointer ------------------------------------------------
+
+def _save_committed(directory, steps, uncommitted=(), coordinator=None):
+    """Save `steps` with commits and `uncommitted` without; distinct
+    payload per step so restores are attributable."""
+    if coordinator is None:
+        coordinator = R.RestartCoordinator(
+            R.InMemoryTransport.make_world(1)[0], barrier_timeout=5.0)
+    ck = Checkpointer(str(directory), max_to_keep=8,
+                      coordinator=coordinator)
+    for s in steps:
+        assert ck.save(s, {"w": np.full(8, float(s))})
+        assert ck.commit_pending() == s
+    for s in uncommitted:
+        assert ck.save(s, {"w": np.full(8, float(s))})
+    ck.wait_until_finished()
+    return ck
+
+
+def test_checkpointer_commit_and_ledger_aware_latest(tmp_path):
+    ck = _save_committed(tmp_path, [2, 4], uncommitted=[5])
+    assert ck.all_steps() == [2, 4, 5]
+    assert ck.committed_steps() == [2, 4]
+    # an on-disk step the commit round never blessed is not restorable
+    assert ck.latest_step() == 4
+    assert ck.locally_valid_steps() == [2, 4]
+    ck.close()
+
+
+def test_consensus_restore_skips_corrupt_and_uncommitted(tmp_path):
+    """The acceptance story, world of one: newest committed step
+    truncated, newest on-disk step uncommitted — restore lands on the
+    newest step that is both committed AND intact."""
+    ev = R.EventLog("t")
+    ck = _save_committed(tmp_path, [2, 4], uncommitted=[5])
+    ck.close()
+    R.corrupt_step_dir(str(tmp_path), 4, mode="truncate")
+    coord = R.RestartCoordinator(R.InMemoryTransport.make_world(1)[0],
+                                 barrier_timeout=5.0, event_log=ev)
+    ck2 = Checkpointer(str(tmp_path), max_to_keep=8, coordinator=coord)
+    state, _ = ck2.restore({"w": np.zeros(8)})
+    np.testing.assert_array_equal(np.asarray(state["w"]), np.full(8, 2.0))
+    assert ev.count("consensus_restore", "ckpt.restore") == 1
+    ck2.close()
+
+
+def test_consensus_restore_cold_start_raises_filenotfound(tmp_path):
+    coord = R.RestartCoordinator(R.InMemoryTransport.make_world(1)[0],
+                                 barrier_timeout=5.0)
+    ck = Checkpointer(str(tmp_path / "empty"), coordinator=coord)
+    with pytest.raises(FileNotFoundError):
+        ck.restore({"w": np.zeros(8)})
+    ck.close()
+
+
+def test_ledger_mode_fallback_never_picks_uncommitted(tmp_path):
+    """use_ledger without a coordinator: the ordinary walk-back is
+    restricted to COMMITTED steps (garbage corruption is only caught at
+    read time, so the walk must still happen — but never into the
+    uncommitted newest write)."""
+    ev = R.EventLog("t")
+    ck = _save_committed(tmp_path, [2, 4], uncommitted=[5])
+    ck.close()
+    R.corrupt_step_dir(str(tmp_path), 4)     # garbage: shallow-ok, read fails
+    ck2 = Checkpointer(str(tmp_path), max_to_keep=8, use_ledger=True,
+                       event_log=ev)
+    assert ck2.latest_step() == 4            # listed until read fails
+    with R.use_event_log(ev):
+        state, _ = ck2.restore({"w": np.zeros(8)})
+    np.testing.assert_array_equal(np.asarray(state["w"]), np.full(8, 2.0))
+    assert ev.count("fallback_restore", "ckpt.restore") >= 1
+    ck2.close()
+
+
+def test_local_valid_fault_site_drops_newest(tmp_path):
+    ck = _save_committed(tmp_path, [2, 4])
+    plan = R.FaultPlan([R.FaultSpec("coord.local_valid", at=(1,),
+                                    error="flag", times=1)])
+    with plan.installed():
+        assert ck.locally_valid_steps() == [2]
+    assert ck.locally_valid_steps() == [2, 4]    # one-shot fault
+    ck.close()
+
+
+def test_commit_pending_without_ledger_is_noop(tmp_path):
+    ck = Checkpointer(str(tmp_path))
+    assert ck.save(3, {"w": np.zeros(4)})
+    assert ck.commit_pending() == 3          # returns the step, no ledger
+    ck.wait_until_finished()
+    assert not R.StepLedger(str(ck.directory)).exists()
+    assert ck.latest_step() == 3             # plain behavior unchanged
+    ck.close()
+
+
+# -- verify CLI ---------------------------------------------------------------
+
+def test_verify_cli_all_steps_json_reports_ledger(tmp_path, capsys):
+    from scripts.verify_checkpoint import main
+    ck = _save_committed(tmp_path / "ck", [2], uncommitted=[4])
+    ck.close()
+    assert main([str(tmp_path / "ck"), "--all-steps", "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["ok"] is True
+    assert report["ledger"]["present"] is True
+    assert report["ledger"]["committed_steps"] == [2]
+    by_step = {s["step"]: s for s in report["steps"]}
+    assert by_step[2]["committed"] is True
+    assert by_step[4]["committed"] is False   # on disk, never committed
+    # human mode carries the same verdicts
+    assert main([str(tmp_path / "ck"), "--all-steps"]) == 0
+    out = capsys.readouterr().out
+    assert "UNCOMMITTED" in out and "committed" in out
+
+
+def test_verify_cli_no_ledger_reports_absent(tmp_path, capsys):
+    from flaxdiff_tpu.trainer.checkpoints import Checkpointer as CK
+    ck = CK(str(tmp_path / "ck"))
+    assert ck.save(2, {"w": np.zeros(4)})
+    ck.wait_until_finished()
+    ck.close()
+    from scripts.verify_checkpoint import main
+    assert main([str(tmp_path / "ck"), "--all-steps", "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["ledger"]["present"] is False
+    assert report["steps"][0]["committed"] is None
+
+
+# -- trainer integration ------------------------------------------------------
+
+def _tiny_trainer(mesh, tmp_path=None, coordinator=None, **cfg_kw):
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import optax
+
+    from flaxdiff_tpu.predictors import EpsilonPredictionTransform
+    from flaxdiff_tpu.schedulers import CosineNoiseSchedule
+    from flaxdiff_tpu.trainer import DiffusionTrainer, TrainerConfig
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, t, cond=None):
+            return nn.Conv(x.shape[-1], (3, 3))(x)
+
+    model = Tiny()
+    ck = None
+    if tmp_path is not None:
+        ck = Checkpointer(str(tmp_path), max_to_keep=8,
+                          coordinator=coordinator)
+    return DiffusionTrainer(
+        apply_fn=lambda p, x, t, c: model.apply({"params": p}, x, t, None),
+        init_fn=lambda key: model.init(key, jnp.zeros((1, 8, 8, 1)),
+                                       jnp.zeros((1,)))["params"],
+        tx=optax.adam(1e-3),
+        schedule=CosineNoiseSchedule(timesteps=100),
+        transform=EpsilonPredictionTransform(),
+        mesh=mesh,
+        config=TrainerConfig(normalize=False, log_every=2, **cfg_kw),
+        checkpointer=ck)
+
+
+def _data(rng, n=64):
+    while True:
+        yield {"sample": rng.normal(size=(8, 8, 8, 1)).astype(np.float32)}
+
+
+def test_restore_at_start_resumes_and_cold_starts(mesh, tmp_path, rng):
+    ev = R.EventLog("t")
+    with R.use_event_log(ev):
+        tr = _tiny_trainer(mesh, tmp_path / "ck", restore_at_start=True)
+        tr.fit(_data(rng), total_steps=3)     # cold start: nothing on disk
+        tr.checkpointer.wait_until_finished()
+    assert ev.count("cold_start", "train.start") == 1
+    tr.checkpointer.close()
+
+    ev2 = R.EventLog("t2")
+    with R.use_event_log(ev2):
+        tr2 = _tiny_trainer(mesh, tmp_path / "ck", restore_at_start=True)
+        tr2.fit(_data(rng), total_steps=2)
+    import jax
+    assert int(jax.device_get(tr2.state.step)) == 5   # resumed 3, ran 2
+    assert ev2.count("restored", "train.start") == 1
+    tr2.checkpointer.close()
+
+
+def test_fit_commits_saves_into_ledger(mesh, tmp_path, rng):
+    coord = R.RestartCoordinator(R.InMemoryTransport.make_world(1)[0],
+                                 barrier_timeout=5.0)
+    tr = _tiny_trainer(mesh, tmp_path / "ck", coordinator=coord)
+    hist = tr.fit(_data(rng), total_steps=4, save_every=2)
+    assert hist["coordination_lost"] is False
+    ck = tr.checkpointer
+    # every save fit made (2, 4) went through the commit round
+    assert ck.ledger.committed_steps() == ck.all_steps()
+    assert ck.latest_step() == 4
+    ck.close()
+
+
+def test_fit_survives_commit_barrier_timeout(mesh, tmp_path, rng):
+    """Crash barrier end-to-end: the peer never votes, the commit round
+    times out, and fit takes the clean checkpoint-and-exit path — the
+    local save still lands on disk, uncommitted — instead of hanging."""
+    ev = R.EventLog("t")
+    # world of 2, but rank 1 is never driven: a dead host
+    t0 = R.InMemoryTransport.make_world(2)[0]
+    coord = R.RestartCoordinator(t0, barrier_timeout=0.5, event_log=ev)
+    tr = _tiny_trainer(mesh, tmp_path / "ck", coordinator=coord)
+    with R.use_event_log(ev):
+        start = time.monotonic()
+        hist = tr.fit(_data(rng), total_steps=20, save_every=2)
+        elapsed = time.monotonic() - start
+    assert hist["coordination_lost"] is True
+    assert hist["preempted"] is True          # stopped early, cleanly
+    assert elapsed < 60
+    assert ev.count("barrier_timeout", "coord.barrier") >= 1
+    assert ev.count("commit_skipped", "ckpt.commit") >= 1
+    ck = tr.checkpointer
+    ck.wait_until_finished()
+    assert ck.all_steps()                     # local durability kept
+    assert ck.ledger.committed_steps() == []  # but nothing committed
+    ck.close()
+
+
+def test_sigterm_handler_failure_warns_not_silent(mesh, rng):
+    """Satellite: fit off the main thread cannot install the SIGTERM
+    handler — that must surface as a resilience warning event, not a
+    silent loss of preemption safety (trainer.py:344 before this PR)."""
+    ev = R.EventLog("t")
+    tr = _tiny_trainer(mesh, checkpoint_on_sigterm=True)
+    errs = []
+
+    def run():
+        try:
+            with R.use_event_log(ev):
+                tr.fit(_data(np.random.default_rng(0)), total_steps=1)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join()
+    assert not errs
+    assert ev.count("warning", "train.sigterm") == 1

@@ -74,3 +74,44 @@ def test_two_process_fsdp_train_save_restore(tmp_path):
     restore = _run_phase("restore", _free_port(), ckpt_dir)
     assert restore[0]["losses"] == restore[1]["losses"]
     assert len(restore[0]["losses"]) == 1
+
+
+@pytest.mark.multiprocess
+def test_two_process_coordinated_restart_consensus(tmp_path):
+    """The asymmetric-corruption acceptance scenario (ISSUE 2), over
+    REAL jax.distributed: steps 2 and 4 two-phase-committed into the
+    ledger, step 5 saved but never committed; then (a) one host's
+    LOCAL view of step 4 goes bad (chaos site) and (b) one host
+    truncates step 4 on disk — in both worlds the processes must agree
+    on step 2: never different steps, never the corrupt 4, never the
+    uncommitted 5."""
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    train = _run_phase("train_coord", _free_port(), ckpt_dir)
+    assert train[0]["losses"] == train[1]["losses"]
+    assert len(train[0]["losses"]) == 5
+    for t in train:
+        # the commit round made exactly 2 and 4 restorable; the
+        # ledgerless newest write (5) is on disk but uncommitted
+        assert t["committed"] == [2, 4]
+        assert t["all_steps"] == [2, 4, 5]
+        assert t["latest"] == 4
+
+    # (a) asymmetric OBSERVED corruption: process 1's valid set drops
+    # step 4; the intersection forces both to the same earlier step
+    asym = _run_phase("restore_coord_asym", _free_port(), ckpt_dir)
+    assert asym[0]["restored"] == asym[1]["restored"] == 2
+    assert asym[0]["losses"] == asym[1]["losses"]
+    assert [a["step_after"] for a in asym] == [3, 3]
+
+    # (b) asymmetric ON-DISK corruption, performed by process 1 only:
+    # the newest COMMITTED step is truncated; consensus again lands on
+    # 2 on BOTH hosts — and never on the intact-but-uncommitted 5
+    corrupt = _run_phase("restore_coord_corrupt", _free_port(), ckpt_dir)
+    assert corrupt[0]["restored"] == corrupt[1]["restored"]
+    assert corrupt[0]["restored"] == 2
+    for c in corrupt:
+        assert c["restored"] not in (4, 5)
+        assert 5 not in c["valid_after"]       # uncommitted: never valid
+        assert 4 not in c["valid_after"]       # truncated: never valid
+    assert corrupt[0]["losses"] == corrupt[1]["losses"]

@@ -112,6 +112,16 @@ def parse_args(argv=None):
                         "train-loop watchdog checkpoints and exits "
                         "cleanly (docs/RESILIENCE.md); default off. Size "
                         "it at several multiples of the step time.")
+    p.add_argument("--coordinated_restart", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="pod-consistent checkpointing: two-phase "
+                        "ledger commits + consensus restore + crash "
+                        "barriers (docs/RESILIENCE.md). auto = on "
+                        "whenever jax.process_count() > 1")
+    p.add_argument("--commit_barrier_timeout", type=float, default=600.0,
+                   help="seconds survivors wait at a commit/restore "
+                        "barrier before declaring a peer dead and "
+                        "taking the checkpoint-and-exit path")
     p.add_argument("--val_every", type=int, default=0,
                    help="0 disables in-loop validation")
     p.add_argument("--val_samples", type=int, default=8)
@@ -416,7 +426,21 @@ def main(argv=None):
                     "(no active wandb run / artifact missing / download "
                     "failed)")
 
-    ckpt = Checkpointer(args.checkpoint_dir)
+    # Coordinated restart (docs/RESILIENCE.md): every host must restore
+    # the SAME committed step after a crash — saves two-phase-commit
+    # into ledger.jsonl and restores run a consensus round. The
+    # in-memory world-of-one transport keeps single-host runs on the
+    # identical code path (ledger included) without jax.distributed.
+    coordinator = None
+    if args.coordinated_restart == "on" or (
+            args.coordinated_restart == "auto"
+            and jax.process_count() > 1):
+        from flaxdiff_tpu.resilience.coordination import (
+            RestartCoordinator, default_transport)
+        coordinator = RestartCoordinator(
+            default_transport(),
+            barrier_timeout=args.commit_barrier_timeout)
+    ckpt = Checkpointer(args.checkpoint_dir, coordinator=coordinator)
     trainer = DiffusionTrainer(
         apply_fn=apply_fn, init_fn=init_fn, tx=tx, schedule=schedule,
         transform=transform, mesh=mesh,
