@@ -8,4 +8,4 @@ parallelism over device meshes, and first-party Pallas kernels.
 
 __version__ = "0.1.0"
 
-from . import predictors, resilience, schedulers, typing, utils
+from . import predictors, resilience, schedulers, telemetry, typing, utils

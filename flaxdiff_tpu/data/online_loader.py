@@ -19,6 +19,7 @@ import numpy as np
 from ..resilience import events as _res_events
 from ..resilience import faults as _res_faults
 from ..resilience.retry import RetryPolicy
+from ..telemetry import global_telemetry as _telemetry
 from .dataloaders import collate, fallback_batch
 
 
@@ -217,6 +218,11 @@ class OnlineStreamingDataLoader:
 
     # -- workers -------------------------------------------------------------
     def _load_one(self, record: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        # sample-level counters land on the process-global telemetry hub
+        # (worker threads have no plumbing); skip reasons are separated
+        # because "filtered by policy" and "failed to fetch/decode" need
+        # opposite responses from an operator
+        tel = _telemetry()
         try:
             if "image" in record:
                 img = record["image"]
@@ -226,14 +232,18 @@ class OnlineStreamingDataLoader:
                 img = decode_image(self.fetcher(record["url"]))
             img = smart_resize(img, self.image_size, self.min_image_size)
             if img is None:
+                tel.counter("data/samples_filtered").inc()
                 return None
             out = {"image": img}
             if "text" in record:
                 out["text"] = record["text"]
             if self.filter_fn is not None and not self.filter_fn(out):
+                tel.counter("data/samples_filtered").inc()
                 return None
+            tel.counter("data/samples_ok").inc()
             return out
         except Exception:
+            tel.counter("data/samples_failed").inc()
             return None
 
     def _worker(self, worker_id: int):
@@ -278,7 +288,8 @@ class OnlineStreamingDataLoader:
         empty_rounds = 0
         while not self._stop.is_set():
             samples = []
-            deadline = time.monotonic() + self.timeout
+            t_batch = time.monotonic()
+            deadline = t_batch + self.timeout
             while len(samples) < self.batch_size:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -287,8 +298,11 @@ class OnlineStreamingDataLoader:
                     samples.append(self.queue.get(timeout=remaining))
                 except queue.Empty:
                     break
+            _telemetry().histogram("data/batch_assembly").observe(
+                time.monotonic() - t_batch)
             if len(samples) == self.batch_size:
                 empty_rounds = 0
+                _telemetry().counter("data/batches").inc()
                 batch = collate(samples)
                 last_good = batch
                 yield batch
@@ -305,6 +319,7 @@ class OnlineStreamingDataLoader:
                            + ("yielding zero fallback batch"
                               if self.starvation_action == "warn"
                               else "failing fast"))
+                _telemetry().counter("data/starved_batches").inc()
                 if self.starvation_action == "raise":
                     raise RuntimeError(
                         "online loader starved: "

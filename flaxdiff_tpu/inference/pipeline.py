@@ -232,12 +232,26 @@ class DiffusionInferencePipeline:
             conditioning = self.input_config.get_unconditionals(
                 batch_size=num_samples)[0]
         ds = self.get_sampler(sampler, guidance_scale)
-        out = ds.generate_samples(
-            params=params, num_samples=num_samples, resolution=resolution,
-            diffusion_steps=diffusion_steps, rngstate=RngSeq.create(seed),
-            sequence_length=sequence_length, channels=channels,
-            conditioning=conditioning, unconditional=unconditional,
-            inpaint_reference=inpaint_reference, inpaint_mask=inpaint_mask)
+        from ..telemetry import global_telemetry
+        tel = global_telemetry()
+        sampler_name = (sampler if isinstance(sampler, str)
+                        else type(ds.sampler).__name__)
+        with tel.span("sampler.generate", cat="inference",
+                      args={"sampler": sampler_name,
+                            "diffusion_steps": diffusion_steps,
+                            "num_samples": num_samples,
+                            "guidance_scale": guidance_scale}):
+            out = ds.generate_samples(
+                params=params, num_samples=num_samples,
+                resolution=resolution,
+                diffusion_steps=diffusion_steps, rngstate=RngSeq.create(seed),
+                sequence_length=sequence_length, channels=channels,
+                conditioning=conditioning, unconditional=unconditional,
+                inpaint_reference=inpaint_reference,
+                inpaint_mask=inpaint_mask)
+            # the scan dispatches async; close the span on real work
+            out = jax.block_until_ready(out)
+        tel.counter("inference/samples_generated").inc(num_samples)
         return np.asarray(jax.device_get(out))
 
 

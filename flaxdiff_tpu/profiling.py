@@ -231,22 +231,30 @@ class MFUMeter:
 @contextlib.contextmanager
 def trace(logdir: str, host_tracer_level: int = 2):
     """jax.profiler capture around a block; view with xprof/tensorboard
-    or perfetto. No-op context if the profiler cannot start (e.g. a
-    second concurrent trace)."""
+    or perfetto. Degrades to a no-op context if the profiler cannot
+    start (e.g. a second concurrent trace) — but records a
+    `trace_failed` resilience event either way, because "the profile I
+    asked for silently doesn't exist" is undiagnosable after the run
+    (the pre-telemetry bare `except: pass` here was exactly that)."""
     started = False
     try:
         jax.profiler.start_trace(logdir)
         started = True
-    except Exception:
-        pass
+    except Exception as e:  # noqa: BLE001 — degrade, but visibly
+        from .resilience.events import record_event
+        record_event("trace_failed", "profiler.start_trace",
+                     detail=f"{type(e).__name__}: {e} (logdir={logdir})")
     try:
         yield
     finally:
         if started:
             try:
                 jax.profiler.stop_trace()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — degrade, but visibly
+                from .resilience.events import record_event
+                record_event("trace_failed", "profiler.stop_trace",
+                             detail=f"{type(e).__name__}: {e} "
+                                    f"(logdir={logdir})")
 
 
 @contextlib.contextmanager

@@ -385,16 +385,27 @@ class RestartCoordinator:
     request a relaunch with a replacement host). Once lost, further
     commits are skipped locally (`commit_skipped` events) so the
     checkpoint-and-exit path never re-enters a hung world.
+
+    Epoch tags: every vote/set/decision payload carries the
+    coordinator's `epoch` (the job-incarnation number — e.g. the
+    telemetry GoodputLedger's incarnation, or a scheduler restart
+    count). A payload from a different epoch — a late voter from a
+    previous incarnation whose stale KV value survived into this
+    round's key — ABORTS a commit round (no ledger entry) and raises
+    ConsensusError on restore, instead of silently counting a dead
+    process's opinion (docs/RESILIENCE.md "Open items", resolved).
     """
 
     def __init__(self, transport: Transport,
                  barrier_timeout: float = DEFAULT_BARRIER_TIMEOUT,
                  event_log: Optional[EventLog] = None,
-                 on_lost: Optional[Callable[[str], None]] = None):
+                 on_lost: Optional[Callable[[str], None]] = None,
+                 epoch: int = 0):
         self.transport = transport
         self.barrier_timeout = barrier_timeout
         self.on_lost = on_lost
         self.lost = False
+        self.epoch = int(epoch)
         self._event_log = event_log
         self._seq = 0
 
@@ -433,6 +444,22 @@ class RestartCoordinator:
             self._mark_lost(f"barrier {name!r}", e)
             raise
 
+    # -- epoch-tagged payloads ----------------------------------------------
+    def _tag(self, value) -> Dict[str, object]:
+        return {"epoch": self.epoch, "value": value}
+
+    def _untag(self, payloads: List) -> Optional[List]:
+        """Values from a gathered list of tagged payloads, or None when
+        ANY payload carries a foreign epoch / no tag at all — a late
+        voter from a previous incarnation (or a foreign writer) whose
+        contribution must invalidate the round, not be counted."""
+        values = []
+        for p in payloads:
+            if not isinstance(p, dict) or p.get("epoch") != self.epoch:
+                return None
+            values.append(p.get("value"))
+        return values
+
     # -- two-phase commit ----------------------------------------------------
     def commit(self, step: Optional[int], ledger: StepLedger,
                meta: Optional[Dict[str, object]] = None) -> Optional[int]:
@@ -447,11 +474,20 @@ class RestartCoordinator:
             return None
         seq = self._next_seq()
         try:
-            votes = self.transport.allgather_json(
-                f"commit.{seq}", step, self.barrier_timeout)
+            raw = self.transport.allgather_json(
+                f"commit.{seq}", self._tag(step), self.barrier_timeout)
         except BarrierTimeout as e:
             self._mark_lost(f"commit vote for step {step}", e)
             raise
+        votes = self._untag(raw)
+        if votes is None:
+            self._events.record(
+                "commit_aborted", "ckpt.commit",
+                detail=f"epoch mismatch in commit votes (this epoch "
+                       f"{self.epoch}, gathered {raw}) — stale voter "
+                       f"from a previous incarnation; step stays "
+                       f"uncommitted", step=step)
+            return None
         if all(v is None for v in votes):
             return None                       # nothing to commit anywhere
         if any(v != step for v in votes):
@@ -491,11 +527,18 @@ class RestartCoordinator:
         local = sorted(set(int(s) for s in local_valid_steps))
         seq = self._next_seq()
         try:
-            sets = self.transport.allgather_json(
-                f"restore.{seq}", local, self.barrier_timeout)
+            raw = self.transport.allgather_json(
+                f"restore.{seq}", self._tag(local), self.barrier_timeout)
         except BarrierTimeout as e:
             self._mark_lost("consensus restore gather", e)
             raise
+        sets = self._untag(raw)
+        if sets is None:
+            raise ConsensusError(
+                f"consensus restore saw a payload from another epoch "
+                f"(this epoch {self.epoch}, gathered {raw}) — a stale "
+                f"contribution from a previous incarnation cannot be "
+                f"allowed to pick the restore step")
         common = set(sets[0]).intersection(*map(set, sets[1:])) \
             if sets else set()
         chosen = max(common) if common else None
@@ -503,11 +546,19 @@ class RestartCoordinator:
         # thing from the same gathered sets, so a mismatch means broken
         # transport or torn ledger views — fail before touching state
         try:
-            decided = self.transport.broadcast_json(
-                f"restore.{seq}.decision", chosen, self.barrier_timeout)
+            raw_decision = self.transport.broadcast_json(
+                f"restore.{seq}.decision", self._tag(chosen),
+                self.barrier_timeout)
         except BarrierTimeout as e:
             self._mark_lost("consensus restore decision", e)
             raise
+        decision = self._untag([raw_decision])
+        if decision is None:
+            raise ConsensusError(
+                f"restore decision carries a foreign epoch (this epoch "
+                f"{self.epoch}, got {raw_decision}) — refusing a stale "
+                f"coordinator's step")
+        decided = decision[0]
         if decided != chosen:
             raise ConsensusError(
                 f"restore decision diverged: coordinator chose {decided}, "

@@ -1,0 +1,84 @@
+"""Telemetry subsystem: step-phase timing, goodput/badput accounting,
+cross-host metric aggregation, and trace-span export.
+
+The reference logs wall-clock epoch time only (SURVEY §5.1); after the
+resilience PRs this framework *survives* faults but could not *account*
+for them. This package is the observability layer every perf item on
+the ROADMAP depends on — you cannot speed up what you cannot attribute:
+
+  metrics     bounded-memory registry (counters / gauges / fixed-bucket
+              streaming histograms) with pluggable exporters: JSONL
+              (default system of record), Prometheus textfile (atomic
+              rename, textfile-collector convention), and fan-out into
+              the existing trainer loggers (JsonlLogger / wandb)
+  phases      StepPhaseTimer: every training step decomposed into
+              data_wait / host / device / checkpoint / eval / other,
+              with the device phase closed by `block_until_ready` so
+              async dispatch cannot lie; feeds profiling.MFUMeter
+  goodput     GoodputLedger: ALL wall-clock classified productive vs.
+              badput (compile, checkpoint_commit, restart, data_stall,
+              coordination_lost, ...), persisted in goodput.json so the
+              account accumulates across job incarnations
+  aggregate   CrossHostAggregator: min/max/mean/p50/p99/spread of
+              per-host metrics over the resilience Transport (real pods
+              via jax.distributed; CPU tests via InMemoryTransport)
+  tracing     TraceRecorder: host-side spans (fit phases, checkpoint
+              rounds, sampler loops, recovery paths) as Chrome
+              trace-event JSON, loadable in Perfetto
+  hub         Telemetry: the bundle the other layers talk to, plus the
+              process-global default (`global_telemetry`) for layers
+              with no plumbing
+
+Offline analysis: `python scripts/diagnose_run.py <telemetry_dir>`
+renders the goodput / phase / pod-skew report from the JSONL stream.
+See docs/OBSERVABILITY.md for metric names and the badput taxonomy.
+
+Dependency direction: trainer/, data/, and inference/ import telemetry;
+telemetry imports nothing from them (and from resilience only lazily,
+to classify a failed aggregation round).
+"""
+from .aggregate import CrossHostAggregator
+from .goodput import GOODPUT_FILENAME, GoodputLedger
+from .hub import (
+    TELEMETRY_JSONL,
+    TRACE_FILENAME,
+    Telemetry,
+    global_telemetry,
+    set_global_telemetry,
+    use_telemetry,
+)
+from .metrics import (
+    DEFAULT_BUCKET_BOUNDS,
+    Counter,
+    Gauge,
+    Histogram,
+    JsonlExporter,
+    LoggerExporter,
+    MetricsRegistry,
+    PrometheusTextfileExporter,
+)
+from .phases import PHASES, StepPhaseTimer
+from .tracing import TraceRecorder
+
+__all__ = [
+    "Telemetry",
+    "global_telemetry",
+    "set_global_telemetry",
+    "use_telemetry",
+    "MetricsRegistry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "DEFAULT_BUCKET_BOUNDS",
+    "JsonlExporter",
+    "PrometheusTextfileExporter",
+    "LoggerExporter",
+    "StepPhaseTimer",
+    "PHASES",
+    "GoodputLedger",
+    "GOODPUT_FILENAME",
+    "CrossHostAggregator",
+    "TraceRecorder",
+    "TELEMETRY_JSONL",
+    "TRACE_FILENAME",
+]

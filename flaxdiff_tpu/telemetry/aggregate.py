@@ -1,0 +1,85 @@
+"""Cross-host metric aggregation over the resilience `Transport`.
+
+Per-host metrics answer "how is MY host doing"; at pod scale the
+actionable question is skew — one slow host sets the pace of every
+collective. This module gathers each host's scalar metrics dict over
+the PR-2 `Transport` abstraction (`JaxDistributedTransport` on real
+pods, `InMemoryTransport` in CPU tests — the exact same protocol) and
+reduces them to min/max/mean/p50/p99 (+ relative spread) per metric, so
+process 0 can log pod-wide figures like `pod/step_time/max` and the
+skew between stragglers and the median.
+
+The gather is a COLLECTIVE: every host must call `aggregate` the same
+number of times at the same points (the trainer calls it at log
+cadence, which SPMD driver code reaches in lockstep — the same
+assumption the commit rounds make). A missed deadline raises the
+transport's BarrierTimeout; the Telemetry hub catches it and disables
+further aggregation rather than letting metrics kill a run.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class CrossHostAggregator:
+    """Stateless reducer over a Transport's `allgather_json`; only the
+    round sequence number is local state (it namespaces the gather keys
+    so rounds can never cross-read)."""
+
+    def __init__(self, transport, timeout: float = 60.0):
+        self.transport = transport
+        self.timeout = timeout
+        self._seq = 0
+
+    @property
+    def process_index(self) -> int:
+        return self.transport.process_index
+
+    @property
+    def world_size(self) -> int:
+        return self.transport.process_count
+
+    def aggregate(self, metrics: Dict[str, float]
+                  ) -> Dict[str, Dict[str, float]]:
+        """Gather every host's `{name: float}` dict; returns
+        `{name: {min, max, mean, p50, p99, spread, hosts}}` computed
+        identically on every host. Metrics missing on some hosts are
+        reduced over the hosts that reported them."""
+        seq, self._seq = self._seq, self._seq + 1
+        clean = {str(k): float(v) for k, v in metrics.items()
+                 if v is not None and np.isfinite(v)}
+        gathered: List[Dict[str, float]] = self.transport.allgather_json(
+            f"telemetry.agg.{seq}", clean, self.timeout)
+        names = sorted({k for d in gathered if isinstance(d, dict)
+                        for k in d})
+        out: Dict[str, Dict[str, float]] = {}
+        for name in names:
+            vals = np.asarray([d[name] for d in gathered
+                               if isinstance(d, dict) and name in d],
+                              dtype=np.float64)
+            if vals.size == 0:
+                continue
+            mean = float(vals.mean())
+            stats = {
+                "min": float(vals.min()),
+                "max": float(vals.max()),
+                "mean": mean,
+                "p50": float(np.percentile(vals, 50)),
+                "p99": float(np.percentile(vals, 99)),
+                "hosts": float(vals.size),
+            }
+            # relative straggler spread: (max - min) / mean — the number
+            # to alarm on (0 on a world of one)
+            stats["spread"] = ((stats["max"] - stats["min"]) / mean
+                               if mean != 0 else 0.0)
+            out[name] = stats
+        return out
+
+    @staticmethod
+    def flatten(stats: Dict[str, Dict[str, float]],
+                prefix: str = "pod") -> Dict[str, float]:
+        """`{"pod/<metric>/<stat>": value}` for exporter snapshots."""
+        return {f"{prefix}/{name}/{stat}": v
+                for name, per in stats.items() for stat, v in per.items()}

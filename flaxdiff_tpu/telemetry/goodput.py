@@ -1,0 +1,145 @@
+"""Goodput/badput accounting: classify ALL wall-clock time of a
+training job into productive training vs. named badput buckets, and
+persist the running totals so they accumulate ACROSS job incarnations.
+
+After PR 1–2 this framework survives faults; this ledger is how a run
+accounts for them: "we trained for 31 h of a 36 h allocation — 2.1 h
+compile, 1.6 h checkpoint commits, 0.8 h restarts, 0.5 h data stalls"
+is the decomposition elastic-training systems (Pulse, arXiv:2606.19163)
+evaluate against, and the prerequisite for every perf item on the
+ROADMAP. Buckets the framework itself attributes:
+
+    compile             first-step host+device time of a fit (jit)
+    checkpoint_commit   save dispatch + two-phase commit rounds
+    restart             restore-at-start / consensus-restore rounds
+    data_stall          host blocked waiting on the input pipeline
+    coordination_lost   commit rounds spent discovering a dead peer
+    eval                in-loop validation/sampling
+
+The set is open — `record_badput` accepts any bucket name. The
+invariant (tested): productive + all badput sums to the attributed
+wall-clock of the run within tolerance; `goodput_fraction` is
+productive over that total.
+
+Persistence mirrors the resilience `StepLedger` philosophy: a small
+JSON file (`goodput.json`, atomic tmp+rename, process 0 only) beside
+the run's telemetry so a coordinated restart RESUMES the account
+instead of zeroing it — each load bumps `incarnations`, and totals are
+reported cumulatively across the job's lives.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+GOODPUT_FILENAME = "goodput.json"
+
+
+class GoodputLedger:
+    """Thread-safe productive/badput time account with cross-incarnation
+    persistence. `path=None` keeps an in-memory account (the process-
+    global default hub), same API, nothing on disk."""
+
+    def __init__(self, path: Optional[str] = None, process_index: int = 0):
+        self.path = path
+        self.process_index = process_index
+        self._lock = threading.Lock()
+        self._productive = 0.0
+        self._badput: Dict[str, float] = {}
+        self._prior_productive = 0.0
+        self._prior_badput: Dict[str, float] = {}
+        self.incarnation = 1
+        if path is not None and os.path.exists(path):
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    prior = json.load(f)
+                self._prior_productive = float(prior.get("productive_s", 0.0))
+                self._prior_badput = {
+                    str(k): float(v)
+                    for k, v in dict(prior.get("badput_s", {})).items()}
+                self.incarnation = int(prior.get("incarnations", 0)) + 1
+            except (json.JSONDecodeError, ValueError, OSError):
+                # a torn write from a crashed incarnation: start a fresh
+                # account rather than refuse to train
+                self.incarnation = 1
+
+    # -- recording -----------------------------------------------------------
+    def record_productive(self, seconds: float) -> None:
+        with self._lock:
+            self._productive += max(float(seconds), 0.0)
+
+    def record_badput(self, bucket: str, seconds: float) -> None:
+        s = max(float(seconds), 0.0)
+        if s == 0.0:
+            return
+        with self._lock:
+            self._badput[bucket] = self._badput.get(bucket, 0.0) + s
+
+    @contextlib.contextmanager
+    def measure_badput(self, bucket: str, clock=time.perf_counter):
+        t0 = clock()
+        try:
+            yield
+        finally:
+            self.record_badput(bucket, clock() - t0)
+
+    # -- queries -------------------------------------------------------------
+    def raw_counters(self) -> Tuple[float, Dict[str, float]]:
+        """(productive, badput) recorded by THIS incarnation only —
+        callers diff two calls for a per-fit delta."""
+        with self._lock:
+            return self._productive, dict(self._badput)
+
+    def totals(self, cumulative: bool = True) -> Dict[str, object]:
+        with self._lock:
+            productive = self._productive
+            badput = dict(self._badput)
+        if cumulative:
+            productive += self._prior_productive
+            for k, v in self._prior_badput.items():
+                badput[k] = badput.get(k, 0.0) + v
+        bad_total = sum(badput.values())
+        total = productive + bad_total
+        return {
+            "incarnations": self.incarnation,
+            "productive_s": productive,
+            "badput_s": badput,
+            "badput_total_s": bad_total,
+            "total_s": total,
+            "goodput_fraction": (productive / total) if total > 0 else None,
+        }
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat metrics view for registry-style exports."""
+        t = self.totals()
+        out = {"goodput/productive_s": t["productive_s"],
+               "goodput/total_s": t["total_s"],
+               "goodput/incarnation": float(self.incarnation)}
+        if t["goodput_fraction"] is not None:
+            out["goodput/fraction"] = t["goodput_fraction"]
+        for k, v in t["badput_s"].items():
+            out[f"goodput/badput/{k}_s"] = v
+        return out
+
+    # -- persistence ---------------------------------------------------------
+    def persist(self) -> None:
+        """Atomic cumulative write (process 0 only — the account is a
+        job-level fact, and hosts' clocks agree to within skew that
+        does not matter at goodput granularity)."""
+        if self.path is None or self.process_index != 0:
+            return
+        t = self.totals(cumulative=True)
+        payload = {"incarnations": self.incarnation,
+                   "productive_s": t["productive_s"],
+                   "badput_s": t["badput_s"],
+                   "updated": time.time()}
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)) or ".",
+                    exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2)
+        os.replace(tmp, self.path)

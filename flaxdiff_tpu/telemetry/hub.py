@@ -1,0 +1,216 @@
+"""The `Telemetry` hub: one object bundling the metrics registry,
+exporters, goodput ledger, trace recorder, and cross-host aggregator —
+what the trainer/data/checkpoint/inference layers actually talk to.
+
+Two modes share one API:
+
+- **disabled** (the process-global default): in-memory registry and
+  goodput account, no exporters, no recorder. Every instrumentation
+  call still works (tests read the in-memory account) but `enabled` is
+  False, so the trainer skips the per-step `block_until_ready` that
+  exact device-phase timing requires — zero behavior change for
+  un-instrumented runs.
+- **enabled** (`Telemetry.create(directory)` / train.py
+  `--telemetry_dir`): JSONL stream + optional Prometheus textfile +
+  optional fan-out into the run's existing loggers, Chrome trace
+  recorder, persistent goodput ledger, and (given a Transport)
+  pod-wide aggregation.
+
+Layers with no plumbing (the data loader's worker threads) record on
+the process-global hub (`global_telemetry()`); tests scope one with
+`use_telemetry(...)` — the same pattern as `resilience.events`.
+
+Dependency direction: telemetry imports nothing from trainer/ or
+data/; the Transport it aggregates over is duck-typed (resilience's
+BarrierTimeout is imported lazily only to classify a failed round).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Dict, List, Optional
+
+from .aggregate import CrossHostAggregator
+from .goodput import GOODPUT_FILENAME, GoodputLedger
+from .metrics import (JsonlExporter, LoggerExporter, MetricsRegistry,
+                      PrometheusTextfileExporter)
+from .phases import StepPhaseTimer
+from .tracing import TraceRecorder
+
+TELEMETRY_JSONL = "telemetry.jsonl"
+TRACE_FILENAME = "trace.json"
+
+
+class Telemetry:
+    def __init__(self,
+                 registry: Optional[MetricsRegistry] = None,
+                 exporters: List = (),
+                 recorder: Optional[TraceRecorder] = None,
+                 goodput: Optional[GoodputLedger] = None,
+                 aggregator: Optional[CrossHostAggregator] = None,
+                 enabled: Optional[bool] = None):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.exporters = list(exporters)
+        self.recorder = recorder
+        self.goodput = goodput if goodput is not None else GoodputLedger()
+        self.aggregator = aggregator
+        # enabled gates the COSTLY instrumentation (per-step device sync,
+        # per-step JSONL rows); cheap counters/spans run regardless
+        self.enabled = bool(enabled) if enabled is not None \
+            else bool(self.exporters or self.recorder)
+
+    @classmethod
+    def create(cls, directory: str,
+               transport=None,
+               prometheus_textfile: Optional[str] = None,
+               logger=None,
+               process_index: Optional[int] = None) -> "Telemetry":
+        """Fully-enabled hub rooted at `directory`. Per-host files get a
+        `_p<rank>` suffix beyond rank 0 so a shared directory never
+        interleaves hosts; the goodput account is job-level (process 0
+        writes, everyone records)."""
+        pid = process_index
+        if pid is None:
+            pid = transport.process_index if transport is not None else 0
+        os.makedirs(directory, exist_ok=True)
+        suffix = "" if pid == 0 else f"_p{pid}"
+
+        def _in_dir(name: str) -> str:
+            stem, ext = os.path.splitext(name)
+            return os.path.join(directory, stem + suffix + ext)
+
+        exporters: List = [JsonlExporter(_in_dir(TELEMETRY_JSONL))]
+        if prometheus_textfile:
+            exporters.append(PrometheusTextfileExporter(prometheus_textfile))
+        if logger is not None:
+            exporters.append(LoggerExporter(logger))
+        return cls(
+            registry=MetricsRegistry(),
+            exporters=exporters,
+            recorder=TraceRecorder(_in_dir(TRACE_FILENAME), pid=pid),
+            goodput=GoodputLedger(os.path.join(directory, GOODPUT_FILENAME),
+                                  process_index=pid),
+            aggregator=(CrossHostAggregator(transport)
+                        if transport is not None else None),
+            enabled=True)
+
+    # -- instruments ---------------------------------------------------------
+    def counter(self, name: str):
+        return self.registry.counter(name)
+
+    def gauge(self, name: str):
+        return self.registry.gauge(name)
+
+    def histogram(self, name: str, **kwargs):
+        return self.registry.histogram(name, **kwargs)
+
+    def step_timer(self, mfu_meter=None) -> StepPhaseTimer:
+        return StepPhaseTimer(registry=self.registry, mfu_meter=mfu_meter)
+
+    # -- tracing -------------------------------------------------------------
+    def span(self, name: str, cat: str = "run",
+             args: Optional[Dict[str, object]] = None):
+        if self.recorder is None:
+            return contextlib.nullcontext()
+        return self.recorder.span(name, cat=cat, args=args)
+
+    def instant(self, name: str, cat: str = "event",
+                args: Optional[Dict[str, object]] = None) -> None:
+        if self.recorder is not None:
+            self.recorder.instant(name, cat=cat, args=args)
+
+    # -- export --------------------------------------------------------------
+    def record_step(self, phases: Dict[str, float]) -> None:
+        """One per-step phase row into the raw JSONL stream."""
+        rec = {"type": "step_phases",
+               "step": int(phases.get("step", -1))}
+        rec.update({k: v for k, v in phases.items() if k != "step"})
+        for ex in self.exporters:
+            ex.write(rec)
+
+    def export(self, step: Optional[int] = None,
+               extra: Optional[Dict[str, float]] = None) -> None:
+        """Registry + goodput snapshot through every exporter."""
+        snap = self.registry.snapshot()
+        snap.update(self.goodput.snapshot())
+        if extra:
+            snap.update(extra)
+        for ex in self.exporters:
+            ex.export(snap, step=step)
+
+    def aggregate(self, metrics: Dict[str, float],
+                  step: Optional[int] = None
+                  ) -> Optional[Dict[str, Dict[str, float]]]:
+        """Pod-wide reduction of this host's metrics; rank 0 writes the
+        flattened stats as a `pod_metrics` JSONL record. A timed-out
+        round (dead peer) disables further aggregation for this hub and
+        records a resilience event — metrics must never kill a run."""
+        if self.aggregator is None:
+            return None
+        try:
+            stats = self.aggregator.aggregate(metrics)
+        except Exception as e:  # noqa: BLE001 — classified below
+            from ..resilience.coordination import BarrierTimeout
+            from ..resilience.events import record_event
+            record_event("telemetry_lost", "telemetry.aggregate",
+                         detail=f"{type(e).__name__}: {e}", step=step)
+            self.aggregator = None
+            if not isinstance(e, BarrierTimeout):
+                raise
+            return None
+        if self.aggregator.process_index == 0:
+            rec: Dict[str, object] = {"type": "pod_metrics",
+                                      "world": self.aggregator.world_size}
+            if step is not None:
+                rec["step"] = int(step)
+            rec.update(CrossHostAggregator.flatten(stats))
+            for ex in self.exporters:
+                ex.write(rec)
+        return stats
+
+    # -- lifecycle -----------------------------------------------------------
+    def flush(self) -> None:
+        if self.recorder is not None:
+            self.recorder.save()
+        self.goodput.persist()
+
+    def close(self) -> None:
+        self.flush()
+        for ex in self.exporters:
+            ex.close()
+
+
+# Process-global default hub (disabled): layers without plumbing record
+# here; tests swap it via use_telemetry.
+_GLOBAL = Telemetry(enabled=False)
+_global_lock = threading.Lock()
+
+
+def global_telemetry() -> Telemetry:
+    return _GLOBAL
+
+
+def set_global_telemetry(hub: Telemetry) -> Telemetry:
+    """Replace the process-global hub; returns the previous one."""
+    global _GLOBAL
+    with _global_lock:
+        prev, _GLOBAL = _GLOBAL, hub
+    return prev
+
+
+class use_telemetry:
+    """Context manager: swap the global hub for a scope (tests)."""
+
+    def __init__(self, hub: Telemetry):
+        self._hub = hub
+        self._prev: Optional[Telemetry] = None
+
+    def __enter__(self) -> Telemetry:
+        self._prev = set_global_telemetry(self._hub)
+        return self._hub
+
+    def __exit__(self, *exc):
+        assert self._prev is not None
+        set_global_telemetry(self._prev)
+        return False

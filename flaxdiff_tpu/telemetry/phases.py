@@ -1,0 +1,109 @@
+"""Per-step phase decomposition: where did each training step's
+wall-clock actually go?
+
+The reference logs wall-clock epoch time only (SURVEY §5.1); an
+aggregate step time cannot distinguish "the input pipeline is starving
+the device" from "the device program regressed" from "checkpoint
+commits are on the critical path". `StepPhaseTimer` splits every step
+into named phases:
+
+    data_wait    host blocked fetching/uploading the next batch
+    host         python dispatch of the jitted step (async — cheap)
+    device       device execution, closed with `block_until_ready` so
+                 async dispatch cannot hide device time inside a later
+                 host phase (the classic async-dispatch lie)
+    checkpoint   save dispatch + two-phase commit round
+    eval         in-loop validation/sampling
+    other        everything unattributed (loop bookkeeping, logging)
+
+The invariant — tested — is that the phases of one step sum to that
+step's wall-clock exactly (`other` is the closing residual, floored at
+zero against clock jitter). Durations feed fixed-bucket histograms
+(`phase/<name>`) in a MetricsRegistry and, optionally, the device
+phase feeds an `MFUMeter` so utilization is computed against device
+time rather than end-to-end step time.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+from .metrics import MetricsRegistry
+
+PHASES = ("data_wait", "host", "device", "checkpoint", "eval")
+
+
+class StepPhaseTimer:
+    """Accumulates named phase durations inside a begin/end step window.
+
+    Usage::
+
+        timer.begin_step(step)
+        with timer.phase("host"):
+            loss = train_step(batch)          # async dispatch
+        with timer.phase("device"):
+            jax.block_until_ready(loss)       # true device close
+        phases = timer.end_step()             # {"host": ..., "wall": ...}
+
+    Not thread-safe by design: one timer belongs to one training loop.
+    Unknown phase names are accepted (the taxonomy is open) and land in
+    their own histogram.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 mfu_meter=None, clock=time.perf_counter):
+        self._registry = registry
+        self._meter = mfu_meter
+        self._clock = clock
+        self._step: Optional[int] = None
+        self._t0 = 0.0
+        self._acc: Dict[str, float] = {}
+        self.last: Optional[Dict[str, float]] = None
+
+    def begin_step(self, step: int) -> None:
+        self._step = int(step)
+        self._acc = {}
+        self._t0 = self._clock()
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            self._acc[name] = self._acc.get(name, 0.0) \
+                + (self._clock() - t0)
+
+    def observe_phase(self, name: str, seconds: float) -> None:
+        """Record an externally-timed phase duration (e.g. an eval pass
+        driven outside the step loop) into the same histograms."""
+        if self._step is not None:
+            self._acc[name] = self._acc.get(name, 0.0) + float(seconds)
+        elif self._registry is not None:
+            self._registry.histogram(f"phase/{name}").observe(seconds)
+
+    def end_step(self) -> Dict[str, float]:
+        """Close the step: returns `{phase: seconds, "other": residual,
+        "wall": total, "step": n}` and feeds the histograms. A second
+        call without `begin_step` raises — a skipped begin means the
+        numbers would silently belong to the wrong step."""
+        if self._step is None:
+            raise RuntimeError("end_step without begin_step")
+        wall = self._clock() - self._t0
+        tracked = sum(self._acc.values())
+        out = dict(self._acc)
+        out["other"] = max(wall - tracked, 0.0)
+        out["wall"] = wall
+        out["step"] = float(self._step)
+        if self._registry is not None:
+            for name, dt in out.items():
+                if name in ("wall", "step"):
+                    continue
+                self._registry.histogram(f"phase/{name}").observe(dt)
+            self._registry.histogram("phase/wall").observe(wall)
+        if self._meter is not None and out.get("device", 0.0) > 0.0:
+            self._meter.observe(out["device"])
+        self.last = out
+        self._step = None
+        return out

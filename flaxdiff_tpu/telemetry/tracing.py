@@ -1,0 +1,104 @@
+"""Span-based tracing in Chrome trace-event JSON (Perfetto-loadable).
+
+`jax.profiler` traces answer "what did the DEVICE do" at kernel
+granularity; the question this module answers is one level up: "what
+did the RUN do" — fit phases, checkpoint save/restore/commit rounds,
+sampler loops, recovery paths — as host-side spans cheap enough to
+leave on for a whole job. The output is the Chrome trace-event format
+(`{"traceEvents": [...]}`), so `chrome://tracing` / https://ui.perfetto.dev
+render the run's life directly, and `scripts/analyze_trace.py`-style
+tooling can post-process it.
+
+Bounded memory: events accumulate in a capped in-memory list; past
+`max_events` new spans are counted in `dropped` instead of stored (a
+run that traces too finely degrades its trace, never its training).
+`save()` rewrites the whole file atomically and may be called
+repeatedly (the trainer flushes at the end of fit; crash loses at most
+the spans since the last flush).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+
+class TraceRecorder:
+    """Collects spans/instants; writes Chrome trace-event JSON."""
+
+    def __init__(self, path: str, pid: int = 0,
+                 max_events: int = 100_000, clock=time.perf_counter):
+        self.path = path
+        self.pid = int(pid)
+        self.max_events = max_events
+        self._clock = clock
+        self._t0 = clock()
+        self._lock = threading.Lock()
+        self._events: list = [
+            {"ph": "M", "name": "process_name", "pid": self.pid,
+             "args": {"name": f"host {self.pid}"}}]
+        self.dropped = 0
+
+    def _now_us(self) -> float:
+        return (self._clock() - self._t0) * 1e6
+
+    def _emit(self, ev: Dict[str, object]) -> None:
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._events.append(ev)
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "run",
+             args: Optional[Dict[str, object]] = None):
+        """Complete-event ("X") span around a block. Exceptions
+        propagate; the span still closes (marked `error: true`) so a
+        crash is visible in the timeline at the exact span it died in."""
+        ts = self._now_us()
+        err = False
+        try:
+            yield
+        except BaseException:
+            err = True
+            raise
+        finally:
+            ev: Dict[str, object] = {
+                "ph": "X", "name": name, "cat": cat, "pid": self.pid,
+                "tid": threading.get_ident() % 1_000_000,
+                "ts": ts, "dur": self._now_us() - ts}
+            a = dict(args or {})
+            if err:
+                a["error"] = True
+            if a:
+                ev["args"] = a
+            self._emit(ev)
+
+    def instant(self, name: str, cat: str = "event",
+                args: Optional[Dict[str, object]] = None) -> None:
+        ev: Dict[str, object] = {
+            "ph": "i", "s": "p", "name": name, "cat": cat,
+            "pid": self.pid, "tid": threading.get_ident() % 1_000_000,
+            "ts": self._now_us()}
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+    def save(self) -> str:
+        """Atomic rewrite of the full trace file; safe to call often."""
+        with self._lock:
+            events = list(self._events)
+            dropped = self.dropped
+        doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if dropped:
+            doc["flaxdiff_dropped_events"] = dropped
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)) or ".",
+                    exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self.path)
+        return self.path

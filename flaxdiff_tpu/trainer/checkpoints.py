@@ -19,6 +19,7 @@ from ..resilience import faults as _faults
 from ..resilience.coordination import (ConsensusError, RestartCoordinator,
                                        StepLedger)
 from ..resilience.retry import RetryError, RetryPolicy
+from ..telemetry import global_telemetry as _telemetry
 from ..typing import PyTree
 
 # Save-side default: object-store writes fail transiently (429/503/socket
@@ -122,12 +123,14 @@ class Checkpointer:
                 force=force)
 
         try:
-            if self._save_retry is not None:
-                started = self._save_retry.call(
-                    attempt, site="ckpt.save", event_log=self._event_log,
-                    step=step)
-            else:
-                started = attempt()
+            with _telemetry().span("ckpt.save", cat="checkpoint",
+                                   args={"step": step}):
+                if self._save_retry is not None:
+                    started = self._save_retry.call(
+                        attempt, site="ckpt.save",
+                        event_log=self._event_log, step=step)
+                else:
+                    started = attempt()
         except (RetryError, OSError) as e:
             # Degrade, don't die: training continues on the device state;
             # the event stream carries the loss of durability.
@@ -172,25 +175,27 @@ class Checkpointer:
         step, self._pending_commit = self._pending_commit, None
         if self._ledger is None:
             return step
-        if step is not None:
-            self.wait_until_finished()
-            from ..resilience.verify import verify_step
-            report = verify_step(str(self._mgr.directory), step)
-            if not report.ok:
-                self._events.record(
-                    "commit_aborted", "ckpt.commit",
-                    detail=f"local write of step {step} failed "
-                           f"verification: {report.errors}", step=step)
-                step = None
-        if self._coordinator is None:
-            # single-host ledger: local write is the whole world
+        with _telemetry().span("ckpt.commit", cat="checkpoint",
+                               args={"step": step}):
             if step is not None:
-                self._ledger.record_commit(step, world_size=1)
-                self._events.record("commit", "ckpt.commit",
-                                    detail=f"step {step} committed "
-                                           "(single host)", step=step)
-            return step
-        return self._coordinator.commit(step, self._ledger)
+                self.wait_until_finished()
+                from ..resilience.verify import verify_step
+                report = verify_step(str(self._mgr.directory), step)
+                if not report.ok:
+                    self._events.record(
+                        "commit_aborted", "ckpt.commit",
+                        detail=f"local write of step {step} failed "
+                               f"verification: {report.errors}", step=step)
+                    step = None
+            if self._coordinator is None:
+                # single-host ledger: local write is the whole world
+                if step is not None:
+                    self._ledger.record_commit(step, world_size=1)
+                    self._events.record("commit", "ckpt.commit",
+                                        detail=f"step {step} committed "
+                                               "(single host)", step=step)
+                return step
+            return self._coordinator.commit(step, self._ledger)
 
     def committed_steps(self):
         """Steps both on disk and recorded in the ledger (ledger mode);
@@ -237,20 +242,28 @@ class Checkpointer:
         is used — N hosts silently restoring N different steps is the
         failure mode this exists to kill."""
         if step is not None:
-            return self._restore_one(abstract_state, step)
+            with _telemetry().span("ckpt.restore", cat="restore",
+                                   args={"step": step}):
+                return self._restore_one(abstract_state, step)
         if self._coordinator is not None:
-            return self._consensus_restore(abstract_state)
+            with _telemetry().span("ckpt.consensus_restore", cat="restore"):
+                return self._consensus_restore(abstract_state)
         steps = sorted(self.committed_steps(), reverse=True)
         if not steps:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.directory}")
         if not fallback:
-            return self._restore_one(abstract_state, steps[0])
+            with _telemetry().span("ckpt.restore", cat="restore",
+                                   args={"step": steps[0]}):
+                return self._restore_one(abstract_state, steps[0])
         last_err: Optional[Exception] = None
         for i, s in enumerate(steps):
             try:
                 _faults.check("ckpt.restore", step=s)
-                restored = self._restore_one(abstract_state, s)
+                with _telemetry().span("ckpt.restore", cat="restore",
+                                       args={"step": s,
+                                             "fallback_depth": i}):
+                    restored = self._restore_one(abstract_state, s)
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e:  # noqa: BLE001 — corrupt dirs raise

@@ -40,11 +40,53 @@ def save_image_grid(images, path: str, pad: int = 2) -> str:
     return path
 
 
+# Largest numeric sequence JsonlLogger serializes inline. Above this a
+# value is data, not a metric — dropped WITH a counter, never silently.
+MAX_INLINE_SEQ = 64
+
+
+def _coerce_value(v):
+    """JSON-serializable form of one metric value, or None if the value
+    is not representable as a (small) metric. Scalars coerce as before;
+    small numeric sequences (lists/tuples/arrays <= MAX_INLINE_SEQ
+    elements) serialize as lists; dicts coerce per-entry one level deep
+    (None entries dropped from the sub-dict)."""
+    if isinstance(v, (str, bool, type(None))):
+        return v
+    if isinstance(v, numbers.Integral):
+        return int(v)                    # covers np.int32/int64
+    if isinstance(v, numbers.Real):
+        return float(v)                  # covers np.float32/float64
+    if isinstance(v, dict):
+        out = {}
+        for k, sub in v.items():
+            c = _coerce_value(sub)
+            if c is not None or sub is None:
+                out[str(k)] = c
+        return out if out else None
+    if isinstance(v, (list, tuple)) or type(v).__name__ == "ndarray":
+        import numpy as np
+        try:
+            arr = np.asarray(v)
+        except Exception:  # noqa: BLE001 — ragged/object input: drop
+            return None
+        if arr.dtype.kind in "biuf" and arr.size <= MAX_INLINE_SEQ:
+            return arr.tolist()
+        return None
+    return None
+
+
 class JsonlLogger:
     """Appends one JSON object per log call — greppable, dependency-free.
     Image grids are written as PNGs under `<dir>/samples/` and referenced
     by path in the stream (the offline stand-in for the reference's wandb
-    sample galleries, general_diffusion_trainer.py:521-558)."""
+    sample galleries, general_diffusion_trainer.py:521-558).
+
+    Values serialize per `_coerce_value`: scalars and SMALL numeric
+    sequences/dicts land in the stream; anything else increments the
+    `telemetry/dropped_keys` counter on the global telemetry hub instead
+    of vanishing invisibly (the pre-telemetry behavior silently dropped
+    every list/dict/array value)."""
 
     def __init__(self, path: str):
         os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
@@ -56,13 +98,16 @@ class JsonlLogger:
         rec = {"_time": time.time()}
         if step is not None:
             rec["step"] = int(step)
+        dropped = 0
         for k, v in data.items():
-            if isinstance(v, (str, bool, type(None))):
-                rec[k] = v
-            elif isinstance(v, numbers.Integral):
-                rec[k] = int(v)          # covers np.int32/int64
-            elif isinstance(v, numbers.Real):
-                rec[k] = float(v)        # covers np.float32/float64
+            c = _coerce_value(v)
+            if c is None and v is not None:
+                dropped += 1
+                continue
+            rec[k] = c
+        if dropped:
+            from ..telemetry import global_telemetry
+            global_telemetry().counter("telemetry/dropped_keys").inc(dropped)
         self._fh.write(json.dumps(rec) + "\n")
 
     def log_images(self, key: str, images, step: Optional[int] = None):

@@ -23,10 +23,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel import fsdp_sharding_tree, sharding_tree
 from ..parallel.mesh import batch_spec
-from ..profiling import compiled_flops, device_peak_flops, mfu
+from ..profiling import MFUMeter, compiled_flops, device_peak_flops, mfu
 from ..predictors import PredictionTransform
 from ..resilience import events as _res_events
 from ..resilience import faults as _res_faults
+from ..telemetry import global_telemetry as _global_telemetry
 from ..schedulers.common import NoiseSchedule
 from ..typing import Policy, PyTree
 from .train_state import TrainState
@@ -95,11 +96,17 @@ class DiffusionTrainer:
                  policy: Optional[Policy] = None,
                  autoencoder: Optional[Any] = None,
                  null_cond: Optional[PyTree] = None,
-                 checkpointer: Optional[Any] = None):
+                 checkpointer: Optional[Any] = None,
+                 telemetry: Optional[Any] = None):
         """apply_fn(params, x_t, t, cond) -> raw output;
-        init_fn(key) -> params (closes over example input shapes)."""
+        init_fn(key) -> params (closes over example input shapes).
+
+        `telemetry`: a telemetry.Telemetry hub; None falls back to the
+        process-global hub at fit time (disabled by default, so
+        un-instrumented runs keep fully-async step dispatch)."""
         self.mesh = mesh
         self.config = config
+        self.telemetry = telemetry
         self.schedule = schedule
         self.transform = transform
         self.checkpointer = checkpointer
@@ -340,12 +347,29 @@ class DiffusionTrainer:
         fault_plan = _res_faults.active_plan()
         nan_pending = False     # step.nan fault armed for next loss read
 
+        # Telemetry: phase timing + goodput attribution always run (an
+        # in-memory account on the default hub costs microseconds); the
+        # per-step device sync and JSONL rows only under an ENABLED hub
+        # — exact device-phase timing requires closing async dispatch
+        # with block_until_ready, which trades the one-deep pipeline for
+        # attribution. MFU from device-phase time rides the same meter.
+        tel = self.telemetry if self.telemetry is not None \
+            else _global_telemetry()
+        timed = tel.enabled
+        device_meter = MFUMeter(peak_flops=peak) if timed else None
+        timer = tel.step_timer(mfu_meter=device_meter)
+        goodput = tel.goodput
+        # per-fit goodput delta: the hub may be process-global/cumulative
+        gp_base_prod, gp_base_bad = goodput.raw_counters()
+
         # Resume-at-start: under coordination this is the consensus
         # round — it must run BEFORE any step so a divergent world
         # raises here, never trains. ConsensusError propagates.
         if cfg.restore_at_start and self.checkpointer is not None:
             try:
-                step0 = self.restore_checkpoint()
+                with tel.span("train.restore_at_start", cat="restore"), \
+                        goodput.measure_badput("restart"):
+                    step0 = self.restore_checkpoint()
                 events.record("restored", "train.start",
                               detail=f"resumed from step {step0}",
                               step=step0)
@@ -439,9 +463,35 @@ class DiffusionTrainer:
         # raising callback) must still restore the SIGTERM handler — a
         # leaked _on_term would swallow every later SIGTERM — and close
         # any open profiler trace.
+        def settle_step(idx: int) -> Dict[str, float]:
+            """Close the step's phase window, emit the per-step row, and
+            attribute its wall-clock to the goodput account: host +
+            device + residual of step 1 is `compile` badput (the jit
+            heuristic — a warm cache mislabels one cheap step), later
+            steps are productive; data waits are `data_stall`; the
+            checkpoint phase is `checkpoint_commit`, or
+            `coordination_lost` when this step's commit round timed out
+            discovering a dead peer."""
+            phases = timer.end_step()
+            if timed:
+                tel.record_step(phases)
+            busy = (phases.get("host", 0.0) + phases.get("device", 0.0)
+                    + phases.get("other", 0.0))
+            if idx == 0:
+                goodput.record_badput("compile", busy)
+            else:
+                goodput.record_productive(busy)
+            goodput.record_badput("data_stall", phases.get("data_wait", 0.0))
+            goodput.record_badput(
+                "coordination_lost" if history["coordination_lost"]
+                else "checkpoint_commit", phases.get("checkpoint", 0.0))
+            return phases
+
         try:
-            batch = next(data)
-            global_batch = self.put_batch(batch)
+            with goodput.measure_badput("data_stall"), \
+                    tel.span("data.first_batch", cat="data"):
+                batch = next(data)
+                global_batch = self.put_batch(batch)
             for i in range(total_steps):
                 if watchdog is not None:
                     watchdog.beat()
@@ -474,17 +524,27 @@ class DiffusionTrainer:
                         profile_ctx.__exit__(None, None, None)
                         profile_ctx = None
                 current = global_batch
+                timer.begin_step(i + 1)
                 if watchdog is not None and i == 0:
                     # first call pays jit compile — not a stall
                     watchdog.pause()
-                pending_loss = self.train_step(current)
+                with timer.phase("host"):
+                    pending_loss = self.train_step(current)
                 if watchdog is not None and i == 0:
                     watchdog.resume()
                 if i + 1 < total_steps:
-                    batch = next(data)
-                    global_batch = self.put_batch(batch)
+                    with timer.phase("data_wait"):
+                        batch = next(data)
+                        global_batch = self.put_batch(batch)
+                if timed:
+                    # close async dispatch so the device phase is real
+                    # device time, not whatever later host op happens to
+                    # block first (the async-dispatch lie)
+                    with timer.phase("device"):
+                        jax.block_until_ready(pending_loss)
                 steps_in_window += 1
 
+                recovered = False
                 if (i + 1) % cfg.log_every == 0 or i == total_steps - 1:
                     loss = float(pending_loss)
                     if nan_pending:
@@ -493,50 +553,84 @@ class DiffusionTrainer:
                         self._recover(loss, step=i + 1)
                         steps_in_window = 0
                         log_t0 = time.perf_counter()
-                        continue
-                    losses.append(loss)
-                    dt = time.perf_counter() - log_t0
-                    bsz = jax.tree_util.tree_leaves(batch)[0].shape[0] \
-                        * jax.process_count()
-                    ips = steps_in_window * bsz / max(dt, 1e-9)
-                    if flops is None and peak:
-                        flops = self.step_flops(global_batch)
-                    step_mfu = (mfu(flops, dt / steps_in_window, peak)
-                                if flops else None)
-                    steps_in_window = 0
-                    history["steps"].append(i + 1)
-                    history["loss"].append(loss)
-                    history["imgs_per_sec"].append(ips)
-                    history["mfu"].append(step_mfu)
-                    metrics = {"imgs_per_sec": ips}
-                    if step_mfu is not None:
-                        metrics["mfu"] = step_mfu
-                    # resilience counters ride the normal metric stream
-                    # (JSONL/wandb via whatever logger the callback wraps)
-                    metrics.update(events.summary())
-                    for cb in callbacks:
-                        cb(i + 1, loss, metrics)
-                    if cfg.keep_best_state and loss < self.best_loss:
-                        self.best_loss = loss
-                        self.best_state = jax.tree_util.tree_map(
-                            jnp.copy, self.state)
-                    log_t0 = time.perf_counter()
+                        recovered = True
+                    else:
+                        losses.append(loss)
+                        dt = time.perf_counter() - log_t0
+                        bsz = jax.tree_util.tree_leaves(batch)[0].shape[0] \
+                            * jax.process_count()
+                        ips = steps_in_window * bsz / max(dt, 1e-9)
+                        if flops is None and peak:
+                            flops = self.step_flops(global_batch)
+                        step_mfu = (mfu(flops, dt / steps_in_window, peak)
+                                    if flops else None)
+                        window_steps = steps_in_window
+                        steps_in_window = 0
+                        history["steps"].append(i + 1)
+                        history["loss"].append(loss)
+                        history["imgs_per_sec"].append(ips)
+                        history["mfu"].append(step_mfu)
+                        metrics = {"imgs_per_sec": ips}
+                        if step_mfu is not None:
+                            metrics["mfu"] = step_mfu
+                        if timed and flops and device_meter.steps:
+                            # utilization against DEVICE time (phase-
+                            # timed), not end-to-end step time: the gap
+                            # between the two numbers IS the host/input
+                            # overhead the phase breakdown localizes
+                            device_meter.flops_per_step = flops
+                            mfu_dev = device_meter.mfu()
+                            if mfu_dev is not None:
+                                metrics["mfu_device"] = mfu_dev
+                        # resilience counters ride the normal metric
+                        # stream (JSONL/wandb via the callback's logger)
+                        metrics.update(events.summary())
+                        for cb in callbacks:
+                            cb(i + 1, loss, metrics)
+                        if cfg.keep_best_state and loss < self.best_loss:
+                            self.best_loss = loss
+                            self.best_state = jax.tree_util.tree_map(
+                                jnp.copy, self.state)
+                        if timed:
+                            tel.gauge("train/loss").set(loss)
+                            tel.gauge("train/imgs_per_sec").set(ips)
+                            # pod-wide skew: every host contributes its
+                            # window means; rank 0 logs min/max/p50/p99.
+                            # A collective — all hosts hit log cadence
+                            # in lockstep (same SPMD-driver assumption
+                            # as the commit rounds).
+                            agg = {"step_time": dt / max(window_steps, 1),
+                                   "imgs_per_sec": ips, "loss": loss}
+                            if timer.last is not None:
+                                agg["data_wait"] = timer.last.get(
+                                    "data_wait", 0.0)
+                                agg["device_time"] = timer.last.get(
+                                    "device", 0.0)
+                            tel.aggregate(agg, step=i + 1)
+                            tel.export(step=i + 1)
+                        log_t0 = time.perf_counter()
 
-                if save_every and (i + 1) % save_every == 0:
+                if not recovered and save_every and (i + 1) % save_every == 0:
                     # Guard the save with a loss check: a NaN at step N must
                     # not be checkpointed while the log-cadence check is
                     # still log_every-1 steps away (VERDICT r1 weak #4). The
                     # sync this forces is amortized over save_every steps.
-                    loss_now = float(pending_loss)
-                    if nan_pending:
-                        loss_now, nan_pending = float("nan"), False
-                    if (not np.isfinite(loss_now)
-                            or loss_now <= cfg.abnormal_loss_floor):
-                        self._recover(loss_now, step=i + 1)
-                    else:
-                        self.save_checkpoint()
-                        count_save()
-                        commit_save()
+                    with timer.phase("checkpoint"):
+                        loss_now = float(pending_loss)
+                        if nan_pending:
+                            loss_now, nan_pending = float("nan"), False
+                        if (not np.isfinite(loss_now)
+                                or loss_now <= cfg.abnormal_loss_floor):
+                            self._recover(loss_now, step=i + 1)
+                        else:
+                            with tel.span("ckpt.save_and_commit",
+                                          cat="checkpoint",
+                                          args={"step": i + 1}):
+                                self.save_checkpoint()
+                                count_save()
+                                commit_save()
+                            goodput.persist()
+                settle_step(i)
 
             # The final save can legitimately outlast the watchdog timeout
             # (sync flush of an async save) — stand the watchdog down
@@ -547,9 +641,13 @@ class DiffusionTrainer:
             # a second SIGTERM arriving during this save — the exact window
             # preemption handling exists to protect — must hit _on_term (a
             # harmless re-mark of stop["flag"]), not the default action.
-            self.save_checkpoint(force=True)
-            count_save()
-            commit_save(final=True)
+            with tel.span("ckpt.final_save", cat="checkpoint"), \
+                    goodput.measure_badput(
+                        "coordination_lost" if history["coordination_lost"]
+                        else "checkpoint_commit"):
+                self.save_checkpoint(force=True)
+                count_save()
+                commit_save(final=True)
         finally:
             if watchdog is not None:
                 watchdog.stop()
@@ -564,9 +662,23 @@ class DiffusionTrainer:
                 signal.signal(signal.SIGTERM,
                               prev_handler if prev_handler is not None
                               else signal.SIG_DFL)
+            # persist trace + goodput even on an exceptional exit — the
+            # post-mortem needs the account most exactly then. I/O
+            # failure must not mask the original exception.
+            try:
+                tel.flush()
+            except OSError as e:
+                events.record("telemetry_lost", "telemetry.flush",
+                              detail=repr(e))
         history["final_loss"] = losses[-1] if losses else float("nan")
         history["best_loss"] = self.best_loss
         history["resilience"] = events.summary()
+        prod, bad = goodput.raw_counters()
+        history["goodput"] = {
+            "productive_s": prod - gp_base_prod,
+            "badput_s": {k: round(v - gp_base_bad.get(k, 0.0), 6)
+                         for k, v in bad.items()
+                         if v - gp_base_bad.get(k, 0.0) > 0.0}}
         return history
 
     def _recover(self, bad_loss: float, step: Optional[int] = None):
@@ -582,7 +694,12 @@ class DiffusionTrainer:
                       "with fresh rng fold"),
             step=step)
         if rolled_back:
-            self.state = jax.tree_util.tree_map(jnp.copy, self.best_state)
+            tel = self.telemetry if self.telemetry is not None \
+                else _global_telemetry()
+            with tel.span("train.rollback", cat="restore",
+                          args={"step": step, "loss": repr(bad_loss)}):
+                self.state = jax.tree_util.tree_map(jnp.copy,
+                                                    self.best_state)
         # else: keep going with fresh RNG fold — the step folds rng by step
         # counter, so the next batch draws different noise.
 

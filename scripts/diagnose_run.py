@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Render a goodput / phase / skew report from a telemetry stream.
+
+Ingests what the telemetry subsystem wrote during a run
+(docs/OBSERVABILITY.md):
+
+    telemetry.jsonl   per-step `step_phases` rows, `metrics` snapshots,
+                      `pod_metrics` aggregates
+    goodput.json      the cumulative productive/badput account
+    trace.json        Chrome trace-event spans (validated, not rendered
+                      — load it in https://ui.perfetto.dev)
+
+and prints the decomposition every perf investigation starts from:
+what fraction of wall-clock trained, where the badput went, which step
+phase dominates, and how skewed the pod is.
+
+Usage:
+    python scripts/diagnose_run.py <telemetry_dir>
+    python scripts/diagnose_run.py run/telemetry.jsonl --json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+
+def _percentile(xs: List[float], q: float) -> float:
+    if not xs:
+        return float("nan")
+    s = sorted(xs)
+    k = (len(s) - 1) * q
+    lo, hi = int(k), min(int(k) + 1, len(s) - 1)
+    return s[lo] + (s[hi] - s[lo]) * (k - lo)
+
+
+def read_jsonl(path: str) -> List[Dict]:
+    out = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue            # torn tail from a crash: skip
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def goodput_section(goodput: Dict, lines: List[str]) -> None:
+    prod = float(goodput.get("productive_s", 0.0))
+    badput = {k: float(v) for k, v in dict(goodput.get("badput_s",
+                                                       {})).items()}
+    total = prod + sum(badput.values())
+    lines.append("== Goodput ==")
+    lines.append(f"incarnations:       {goodput.get('incarnations', 1)}")
+    lines.append(f"attributed total:   {total:10.2f} s")
+    if total > 0:
+        lines.append(f"productive:         {prod:10.2f} s  "
+                     f"({prod / total:6.1%})  <- goodput fraction")
+        for k in sorted(badput, key=badput.get, reverse=True):
+            lines.append(f"badput {k:<12s} {badput[k]:10.2f} s  "
+                         f"({badput[k] / total:6.1%})")
+    lines.append("")
+
+
+def phase_section(steps: List[Dict], lines: List[str]) -> None:
+    lines.append(f"== Step phases ({len(steps)} steps) ==")
+    if not steps:
+        lines.append("(no step_phases records — was the run telemetry-"
+                     "enabled?)")
+        lines.append("")
+        return
+    names = sorted({k for r in steps for k in r
+                    if k not in ("type", "step", "_time", "wall")})
+    walls = [float(r.get("wall", 0.0)) for r in steps]
+    wall_total = sum(walls)
+    lines.append(f"{'phase':<12s} {'total s':>10s} {'% wall':>8s} "
+                 f"{'mean ms':>10s} {'p50 ms':>10s} {'p99 ms':>10s}")
+    for name in names:
+        vals = [float(r.get(name, 0.0)) for r in steps]
+        tot = sum(vals)
+        lines.append(
+            f"{name:<12s} {tot:10.2f} "
+            f"{(tot / wall_total if wall_total else 0.0):8.1%} "
+            f"{1e3 * tot / len(vals):10.2f} "
+            f"{1e3 * _percentile(vals, 0.5):10.2f} "
+            f"{1e3 * _percentile(vals, 0.99):10.2f}")
+    lines.append(f"{'wall':<12s} {wall_total:10.2f} {'':>8s} "
+                 f"{1e3 * wall_total / len(walls):10.2f} "
+                 f"{1e3 * _percentile(walls, 0.5):10.2f} "
+                 f"{1e3 * _percentile(walls, 0.99):10.2f}")
+    lines.append("")
+
+
+def pod_section(pods: List[Dict], lines: List[str]) -> None:
+    if not pods:
+        return
+    last = pods[-1]
+    world = int(last.get("world", 1))
+    lines.append(f"== Pod skew (world of {world}, "
+                 f"step {last.get('step', '?')}) ==")
+    metrics = sorted({k.split("/")[1] for k in last
+                      if k.startswith("pod/") and k.count("/") == 2})
+    lines.append(f"{'metric':<16s} {'min':>10s} {'p50':>10s} {'p99':>10s} "
+                 f"{'max':>10s} {'spread':>8s}")
+    for m in metrics:
+        def g(stat, m=m):
+            return float(last.get(f"pod/{m}/{stat}", float("nan")))
+        lines.append(f"{m:<16s} {g('min'):10.4f} {g('p50'):10.4f} "
+                     f"{g('p99'):10.4f} {g('max'):10.4f} "
+                     f"{g('spread'):8.1%}")
+    lines.append("")
+
+
+def counters_section(metrics: List[Dict], lines: List[str]) -> None:
+    if not metrics:
+        return
+    last = metrics[-1]
+    interesting = {k: v for k, v in last.items()
+                   if isinstance(v, (int, float))
+                   and (k.startswith(("data/", "telemetry/", "resilience/",
+                                      "inference/"))
+                        or k.startswith("goodput/"))}
+    if not interesting:
+        return
+    lines.append("== Counters (last snapshot) ==")
+    for k in sorted(interesting):
+        lines.append(f"{k:<44s} {interesting[k]:>12.4g}")
+    lines.append("")
+
+
+def validate_trace(trace_path: str, lines: List[str]) -> bool:
+    try:
+        with open(trace_path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        events = doc.get("traceEvents", [])
+        spans = [e for e in events if e.get("ph") == "X"]
+        lines.append(f"trace: {trace_path} — valid JSON, "
+                     f"{len(spans)} spans / {len(events)} events "
+                     f"(load in https://ui.perfetto.dev)")
+        return True
+    except (OSError, json.JSONDecodeError) as e:
+        lines.append(f"trace: {trace_path} — UNREADABLE ({e})")
+        return False
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="goodput/phase/skew report from a telemetry stream")
+    ap.add_argument("path", help="telemetry dir, or a telemetry.jsonl")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON object instead")
+    args = ap.parse_args(argv)
+
+    if os.path.isdir(args.path):
+        directory = args.path
+        jsonl = os.path.join(directory, "telemetry.jsonl")
+    else:
+        directory = os.path.dirname(os.path.abspath(args.path))
+        jsonl = args.path
+    if not os.path.exists(jsonl):
+        raise SystemExit(f"no telemetry stream at {jsonl}")
+
+    records = read_jsonl(jsonl)
+    steps = [r for r in records if r.get("type") == "step_phases"]
+    pods = [r for r in records if r.get("type") == "pod_metrics"]
+    metrics = [r for r in records if r.get("type") == "metrics"]
+
+    goodput: Dict = {}
+    gp_path = os.path.join(directory, "goodput.json")
+    if os.path.exists(gp_path):
+        with open(gp_path, "r", encoding="utf-8") as f:
+            goodput = json.load(f)
+    elif metrics:
+        # reconstruct from the last snapshot's goodput/* gauges
+        last = metrics[-1]
+        goodput = {
+            "incarnations": int(last.get("goodput/incarnation", 1)),
+            "productive_s": last.get("goodput/productive_s", 0.0),
+            "badput_s": {k[len("goodput/badput/"):-2]: v
+                         for k, v in last.items()
+                         if k.startswith("goodput/badput/")},
+        }
+
+    if args.json:
+        wall = sum(float(r.get("wall", 0.0)) for r in steps)
+        doc = {"goodput": goodput, "steps": len(steps),
+               "step_wall_s": wall,
+               "pod_last": (pods[-1] if pods else None)}
+        print(json.dumps(doc, indent=2))
+        return 0
+
+    lines: List[str] = [f"telemetry report: {jsonl}", ""]
+    goodput_section(goodput, lines)
+    phase_section(steps, lines)
+    pod_section(pods, lines)
+    counters_section(metrics, lines)
+    trace_path = os.path.join(directory, "trace.json")
+    if os.path.exists(trace_path):
+        validate_trace(trace_path, lines)
+    print("\n".join(lines))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

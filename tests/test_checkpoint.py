@@ -198,10 +198,12 @@ def test_validator_runs_metrics(mesh, rng):
 def test_jsonl_logger(tmp_path):
     path = str(tmp_path / "log.jsonl")
     lg = JsonlLogger(path)
-    lg.log({"loss": 0.5, "skip": [1, 2]}, step=10)
+    lg.log({"loss": 0.5, "curve": [1, 2]}, step=10)
     lg.log({"loss": 0.25}, step=20)
     lg.finish()
     lines = [json.loads(l) for l in open(path)]
     assert lines[0]["step"] == 10 and lines[0]["loss"] == 0.5
-    assert "skip" not in lines[0]
+    # small numeric sequences serialize (telemetry PR bugfix; the old
+    # logger silently dropped every list/dict/array value)
+    assert lines[0]["curve"] == [1, 2]
     assert lines[1]["loss"] == 0.25

@@ -420,3 +420,61 @@ def test_sigterm_handler_failure_warns_not_silent(mesh, rng):
     t.join()
     assert not errs
     assert ev.count("warning", "train.sigterm") == 1
+
+# -- epoch-tagged vote payloads (docs/RESILIENCE.md open item) ----------------
+
+def test_same_epoch_commit_roundtrips(tmp_path):
+    """Tagging is invisible when the world shares one incarnation."""
+    ev = R.EventLog("t")
+    c0, c1 = [R.RestartCoordinator(t, barrier_timeout=5.0, event_log=ev,
+                                   epoch=7)
+              for t in R.InMemoryTransport.make_world(2)]
+    led = R.StepLedger(str(tmp_path))
+    assert _both(lambda: c0.commit(4, led),
+                 lambda: c1.commit(4, led)) == [4, 4]
+    assert led.committed_steps() == [4]
+
+
+def test_stale_epoch_vote_aborts_commit(tmp_path):
+    """A late voter from a PREVIOUS incarnation: its stale KV value
+    survives under this round's key. The epoch tag turns what would
+    have been a silently-counted vote into a clean abort — the step
+    never becomes restorable."""
+    ev = R.EventLog("t")
+    t0, t1 = R.InMemoryTransport.make_world(2)
+    # incarnation 1's rank-1 voted step 4 and died; its payload is
+    # still in the store when incarnation 2's round begins
+    t1._world.put("ag/commit.0/1", json.dumps({"epoch": 1, "value": 4}))
+    c0 = R.RestartCoordinator(t0, barrier_timeout=5.0, event_log=ev,
+                              epoch=2)
+    led = R.StepLedger(str(tmp_path))
+    assert c0.commit(4, led) is None
+    assert led.committed_steps() == []
+    aborts = ev.events("commit_aborted")
+    assert aborts and "epoch" in aborts[0].detail
+
+
+def test_stale_epoch_set_poisons_consensus(tmp_path):
+    """Same scenario on the restore path: a stale incarnation's step
+    set must raise ConsensusError, never pick the restore step."""
+    t0, t1 = R.InMemoryTransport.make_world(2)
+    t1._world.put("ag/restore.0/1",
+                  json.dumps({"epoch": 0, "value": [2, 4]}))
+    c0 = R.RestartCoordinator(t0, barrier_timeout=5.0, epoch=3)
+    with pytest.raises(R.ConsensusError, match="epoch"):
+        c0.consensus_restore_step([2, 4])
+
+
+def test_untagged_payload_rejected(tmp_path):
+    """A foreign writer (pre-epoch binary, corrupted payload) that
+    gathers as a raw value — not a tagged dict — is treated exactly
+    like a stale epoch: abort, don't guess."""
+    ev = R.EventLog("t")
+    t0, t1 = R.InMemoryTransport.make_world(2)
+    t1._world.put("ag/commit.0/1", json.dumps(4))     # untagged vote
+    c0 = R.RestartCoordinator(t0, barrier_timeout=5.0, event_log=ev,
+                              epoch=0)
+    led = R.StepLedger(str(tmp_path))
+    assert c0.commit(4, led) is None
+    assert led.committed_steps() == []
+    assert ev.count("commit_aborted", "ckpt.commit") == 1

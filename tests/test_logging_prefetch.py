@@ -16,7 +16,8 @@ class TestJsonlLogger:
         lg = JsonlLogger(str(tmp_path / "log.jsonl"))
         lg.log({"loss": np.float32(0.5), "count": np.int64(3),
                 "name": "run", "flag": True, "none": None,
-                "skipped_array": np.zeros(3)}, step=np.int32(7))
+                "small_array": np.zeros(3),
+                "huge_array": np.zeros((64, 64))}, step=np.int32(7))
         lg.finish()
         rec = json.loads(open(tmp_path / "log.jsonl").read())
         assert rec["loss"] == 0.5 and isinstance(rec["loss"], float)
@@ -24,7 +25,11 @@ class TestJsonlLogger:
         assert rec["step"] == 7
         assert rec["name"] == "run" and rec["flag"] is True
         assert rec["none"] is None
-        assert "skipped_array" not in rec   # non-scalars are dropped
+        # small numeric sequences serialize inline (the pre-telemetry
+        # logger dropped EVERY non-scalar silently); oversized arrays
+        # are still dropped, but counted — see test_telemetry.py
+        assert rec["small_array"] == [0.0, 0.0, 0.0]
+        assert "huge_array" not in rec
         assert "_time" in rec
 
     def test_log_images_writes_png_and_reference(self, tmp_path):

@@ -4,7 +4,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from flaxdiff_tpu.profiling import (MFUMeter, compiled_flops,
-                                    device_peak_flops, mfu,
+                                    device_peak_flops, jaxpr_flops, mfu,
                                     trace, traced_model_flops)
 
 
@@ -98,6 +98,78 @@ def test_traced_model_flops_grad_and_scan():
         h, _ = jax.lax.scan(body, x, None, length=5)
         return h
     assert traced_model_flops(scanned, w) == 5 * 2 * 4 * 8 * 8
+
+
+def test_jaxpr_flops_scan_multiplies_by_trip_count():
+    """Direct unit: a scan body's FLOPs count `length` times — the
+    trip-count multiplication, exercised straight on the jaxpr (not
+    through the traced_model_flops wrapper)."""
+    w = jnp.ones((8, 8), jnp.float32)
+    x = jnp.ones((4, 8), jnp.float32)
+
+    def scanned(w, x):
+        def body(h, _):
+            return h @ w, ()
+        h, _ = jax.lax.scan(body, x, None, length=7)
+        return h
+
+    closed = jax.make_jaxpr(scanned)(w, x)
+    per_iter = 2 * 4 * 8 * 8
+    assert jaxpr_flops(closed.jaxpr) == 7 * per_iter
+    # trip count scales linearly: double length, double FLOPs
+    def scanned14(w, x):
+        def body(h, _):
+            return h @ w, ()
+        h, _ = jax.lax.scan(body, x, None, length=14)
+        return h
+    closed14 = jax.make_jaxpr(scanned14)(w, x)
+    assert jaxpr_flops(closed14.jaxpr) == 14 * per_iter
+
+
+def test_jaxpr_flops_cond_counts_max_branch():
+    """Direct unit: `cond` accounts the most expensive branch (a static
+    FLOPs figure must be an upper bound over the runtime path), not the
+    sum of branches and not the cheap one."""
+    big = jnp.ones((8, 64), jnp.float32)     # x @ big: 2*4*8*64
+    small = jnp.ones((8, 2), jnp.float32)    # x @ small: 2*4*8*2
+    x = jnp.ones((4, 8), jnp.float32)
+
+    def f(pred, x, big, small):
+        return jax.lax.cond(
+            pred,
+            lambda ops: (ops[0] @ ops[1]).sum(),
+            lambda ops: (ops[0] @ ops[2]).sum(),
+            (x, big, small))
+
+    closed = jax.make_jaxpr(f)(True, x, big, small)
+    expensive = 2 * 4 * 8 * 64
+    cheap = 2 * 4 * 8 * 2
+    got = jaxpr_flops(closed.jaxpr)
+    assert got == expensive, (got, expensive, cheap)
+    # falsifiability: had it summed branches it would be expensive+cheap
+    assert got != expensive + cheap
+
+
+def test_jaxpr_flops_nested_scan_of_cond():
+    """Composition: a cond inside a scan body multiplies the max branch
+    by the trip count."""
+    big = jnp.ones((8, 16), jnp.float32)
+    x = jnp.ones((4, 8), jnp.float32)
+
+    def f(x, big):
+        def body(h, i):
+            h = jax.lax.cond(i % 2 == 0,
+                             lambda ops: ops[0] @ ops[1],
+                             lambda ops: ops[0] @ ops[1] * 2.0,
+                             (h @ jnp.ones((16, 8)), big))
+            return h, ()
+        h, _ = jax.lax.scan(body, x @ big, jnp.arange(3))
+        return h
+
+    closed = jax.make_jaxpr(f)(x, big)
+    outer = 2 * 4 * 8 * 16                       # x @ big before the scan
+    per_iter = 2 * 4 * 16 * 8 + 2 * 4 * 8 * 16  # h@ones then branch matmul
+    assert jaxpr_flops(closed.jaxpr) == outer + 3 * per_iter
 
 
 def test_traced_model_flops_unpadded_vs_compiled():

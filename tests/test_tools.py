@@ -139,3 +139,53 @@ def test_bench_sigterm_emits_final_line(tmp_path):
     final = json.loads(out.strip().splitlines()[-1])
     assert final.get("terminated", "").startswith("signal")
     assert "partial" not in final
+
+
+# -- silent-exception gate (scripts/check_bare_except.py) ---------------------
+
+def test_repo_has_no_new_silent_excepts():
+    """Tier-1 gate: a new `except Exception: pass` outside the
+    grandfathered allowlist fails the build — the observability layer's
+    worst enemy is a failure that leaves no trace."""
+    from scripts.check_bare_except import main
+    assert main([]) == 0
+
+
+def test_bare_except_gate_flags_new_offender(tmp_path, capsys):
+    bad = tmp_path / "offender.py"
+    bad.write_text(
+        "def f():\n"
+        "    try:\n"
+        "        risky()\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "    try:\n"
+        "        risky()\n"
+        "    except (ValueError, BaseException):\n"
+        "        ...\n")
+    from scripts.check_bare_except import main
+    assert main(["--root", str(bad)]) == 1
+    err = capsys.readouterr().err
+    assert "offender.py:4" in err and "offender.py:8" in err
+
+
+def test_bare_except_gate_accepts_handlers_that_act(tmp_path):
+    """Handlers that log, record, re-raise, or return a fallback are
+    NOT silent — only do-nothing bodies fail."""
+    ok = tmp_path / "fine.py"
+    ok.write_text(
+        "def f():\n"
+        "    try:\n"
+        "        risky()\n"
+        "    except Exception as e:\n"
+        "        record_event('x', 'y', detail=repr(e))\n"
+        "    try:\n"
+        "        risky()\n"
+        "    except ValueError:\n"     # narrow catch: allowed even silent
+        "        pass\n"
+        "    try:\n"
+        "        risky()\n"
+        "    except Exception:\n"
+        "        raise RuntimeError('context')\n")
+    from scripts.check_bare_except import main
+    assert main(["--root", str(ok)]) == 0

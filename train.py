@@ -107,6 +107,20 @@ def parse_args(argv=None):
     p.add_argument("--profile_dir", default=None,
                    help="capture a jax.profiler trace of a few post-warmup "
                         "steps into this directory")
+    p.add_argument("--telemetry_dir", default=None,
+                   help="enable the telemetry subsystem "
+                        "(docs/OBSERVABILITY.md): per-step phase timings "
+                        "+ pod-aggregated metrics into telemetry.jsonl, "
+                        "a cumulative goodput/badput account in "
+                        "goodput.json, and host-side spans in a "
+                        "Perfetto-loadable trace.json. Costs one device "
+                        "sync per step (exact device-phase timing). "
+                        "Analyze with scripts/diagnose_run.py")
+    p.add_argument("--prometheus_textfile", default=None,
+                   help="also export the telemetry snapshot to this path "
+                        "in Prometheus text format (atomic rename; "
+                        "node-exporter textfile-collector convention). "
+                        "Requires --telemetry_dir")
     p.add_argument("--watchdog_timeout", type=float, default=None,
                    help="seconds without a completed step before the "
                         "train-loop watchdog checkpoints and exits "
@@ -396,6 +410,27 @@ def main(argv=None):
     # to the counter metrics fit merges at log cadence
     from flaxdiff_tpu.trainer import attach_resilience
     attach_resilience(logger)
+
+    # Telemetry (docs/OBSERVABILITY.md): phase timings, goodput ledger,
+    # trace spans, pod aggregation. Installed as the process-global hub
+    # so layers without plumbing (the data loader's workers, the
+    # checkpointer) land on the same account; the world-of-one in-memory
+    # transport keeps single-host runs on the identical aggregation
+    # code path.
+    telemetry = None
+    if args.telemetry_dir:
+        from flaxdiff_tpu.resilience.coordination import (
+            InMemoryTransport, JaxDistributedTransport)
+        from flaxdiff_tpu.telemetry import Telemetry, set_global_telemetry
+        tel_transport = (JaxDistributedTransport("flaxdiff.telemetry")
+                         if jax.process_count() > 1
+                         else InMemoryTransport.make_world(1)[0])
+        telemetry = Telemetry.create(
+            args.telemetry_dir, transport=tel_transport,
+            prometheus_textfile=args.prometheus_textfile, logger=logger)
+        set_global_telemetry(telemetry)
+    elif args.prometheus_textfile:
+        raise SystemExit("--prometheus_textfile requires --telemetry_dir")
     if args.wandb_resume:
         has_local = any(d.isdigit()
                         for d in os.listdir(args.checkpoint_dir))
@@ -439,7 +474,13 @@ def main(argv=None):
             RestartCoordinator, default_transport)
         coordinator = RestartCoordinator(
             default_transport(),
-            barrier_timeout=args.commit_barrier_timeout)
+            barrier_timeout=args.commit_barrier_timeout,
+            # epoch-tagged vote payloads: the goodput ledger's
+            # incarnation count IS the job-incarnation number, so a
+            # stale voter from a previous life aborts the round instead
+            # of corrupting it (docs/RESILIENCE.md)
+            epoch=(telemetry.goodput.incarnation
+                   if telemetry is not None else 0))
     ckpt = Checkpointer(args.checkpoint_dir, coordinator=coordinator)
     trainer = DiffusionTrainer(
         apply_fn=apply_fn, init_fn=init_fn, tx=tx, schedule=schedule,
@@ -451,7 +492,7 @@ def main(argv=None):
                              flat_params=args.flat_params,
                              watchdog_timeout=args.watchdog_timeout),
         policy=policy, null_cond=null_cond, checkpointer=ckpt,
-        autoencoder=autoencoder)
+        autoencoder=autoencoder, telemetry=telemetry)
 
     if ckpt.latest_step() is not None:
         step = trainer.restore_checkpoint()
@@ -551,9 +592,23 @@ def main(argv=None):
                 unc = {"text": jnp.asarray(
                     input_config.get_unconditionals(args.val_samples)[0])}
             real_batch = next(it)  # real images for FID / CLIP references
-            result = validator.run(trainer.get_params(use_ema=True),
-                                   conditioning=cond, unconditional=unc,
-                                   batch=real_batch)
+            if telemetry is not None:
+                import contextlib as _ctx
+                eval_scope = _ctx.ExitStack()
+                eval_scope.enter_context(
+                    telemetry.span("validation", cat="eval",
+                                   args={"step": done}))
+                eval_scope.enter_context(
+                    telemetry.goodput.measure_badput("eval"))
+            else:
+                eval_scope = None
+            try:
+                result = validator.run(trainer.get_params(use_ema=True),
+                                       conditioning=cond, unconditional=unc,
+                                       batch=real_batch)
+            finally:
+                if eval_scope is not None:
+                    eval_scope.close()
             logger.log({f"val/{k}": v
                         for k, v in result["metrics"].items()}, step=done)
             logger.log_images("val/samples",
@@ -572,6 +627,8 @@ def main(argv=None):
     # sees the same final metrics and registry.json lives on a shared
     # filesystem.
     if jax.process_index() != 0:
+        if telemetry is not None:
+            telemetry.close()
         logger.finish()
         ckpt.wait_until_finished()
         return hist
@@ -596,6 +653,17 @@ def main(argv=None):
     logger.log({f"registry/best_{k}": v for k, v in became_best.items()},
                step=done)
 
+    if telemetry is not None:
+        # final snapshot + trace/goodput flush; the goodput line is the
+        # run's one-sentence efficiency summary
+        telemetry.export(step=done)
+        telemetry.close()
+        t = telemetry.goodput.totals()
+        if t["goodput_fraction"] is not None:
+            print(f"goodput: {t['goodput_fraction']:.1%} of "
+                  f"{t['total_s']:.0f}s attributed wall-clock "
+                  f"(incarnation {t['incarnations']}); report: "
+                  f"python scripts/diagnose_run.py {args.telemetry_dir}")
     logger.finish()
     ckpt.wait_until_finished()
     print(f"done: {done} steps, final loss {hist['final_loss']:.4f}")
