@@ -31,6 +31,7 @@ from .coordination import (
     RestartCoordinator,
     StepLedger,
     Transport,
+    agree_epoch,
     default_transport,
 )
 from .events import (
@@ -85,5 +86,6 @@ __all__ = [
     "InMemoryTransport",
     "JaxDistributedTransport",
     "RestartCoordinator",
+    "agree_epoch",
     "default_transport",
 ]

@@ -198,6 +198,14 @@ class Transport:
         """Process 0's `obj` to everyone (non-0 callers' obj is ignored)."""
         raise NotImplementedError
 
+    def offer_json(self, name: str, obj) -> None:
+        """Non-blocking, best-effort contribution of this host's payload
+        under a gather's key — the write half of `allgather_json` without
+        the wait. Used to publish tombstones (e.g. "aggregation
+        disabled") that unblock peers still gathering; overwrites any
+        earlier contribution to the same round."""
+        raise NotImplementedError
+
 
 class _InMemoryWorld:
     """Shared state behind a set of InMemoryTransports (one per
@@ -271,6 +279,9 @@ class InMemoryTransport(Transport):
             self._world.put(f"bc/{name}", json.dumps(obj))
             return obj
         return json.loads(self._world.get(f"bc/{name}", timeout))
+
+    def offer_json(self, name: str, obj) -> None:
+        self._world.put(f"ag/{name}/{self.process_index}", json.dumps(obj))
 
 
 def _is_deadline_error(e: Exception) -> bool:
@@ -349,6 +360,16 @@ class JaxDistributedTransport(Transport):
                     f"within {timeout}s: {e}") from e
             raise
 
+    def offer_json(self, name: str, obj) -> None:
+        key = f"{self._ns}/ag/{name}/{self.process_index}"
+        payload = json.dumps(obj)
+        try:
+            self._client.key_value_set(key, payload, allow_overwrite=True)
+        except TypeError:
+            # older jax: no allow_overwrite kwarg; a duplicate-key error
+            # then means our real contribution is already up — fine
+            self._client.key_value_set(key, payload)
+
 
 def default_transport() -> Transport:
     """The right transport for this process: the jax.distributed backend
@@ -359,6 +380,30 @@ def default_transport() -> Transport:
     if jax.process_count() > 1:
         return JaxDistributedTransport()
     return InMemoryTransport.make_world(1)[0]
+
+
+def agree_epoch(transport: Transport, local_epoch: int,
+                timeout: float = DEFAULT_BARRIER_TIMEOUT,
+                event_log: Optional[EventLog] = None) -> int:
+    """The pod-wide job-incarnation number: process 0's `local_epoch`,
+    broadcast to everyone. Epoch tags only protect a round when every
+    host tags with the SAME value, but the natural local source (the
+    goodput ledger's incarnation) is written by process 0 only — with a
+    host-local telemetry dir, or after a torn read on one host, local
+    incarnations diverge and every tagged round would abort forever.
+    Call this once at startup and hand the result to RestartCoordinator.
+
+    A host whose local value differs records an `epoch_adopted` event
+    (diagnosable skew, not an error: rank 0 is authoritative)."""
+    agreed = int(transport.broadcast_json("epoch.agree", int(local_epoch),
+                                          timeout))
+    if agreed != int(local_epoch):
+        log_ = event_log if event_log is not None else global_event_log()
+        log_.record("epoch_adopted", "coord.epoch",
+                    detail=f"local incarnation {int(local_epoch)} -> "
+                           f"agreed epoch {agreed} (process 0's goodput "
+                           f"account is authoritative)")
+    return agreed
 
 
 # -- the protocol -------------------------------------------------------------

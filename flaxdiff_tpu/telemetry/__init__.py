@@ -37,7 +37,11 @@ Dependency direction: trainer/, data/, and inference/ import telemetry;
 telemetry imports nothing from them (and from resilience only lazily,
 to classify a failed aggregation round).
 """
-from .aggregate import CrossHostAggregator
+from .aggregate import (
+    DISABLED_SENTINEL,
+    AggregationDisabled,
+    CrossHostAggregator,
+)
 from .goodput import GOODPUT_FILENAME, GoodputLedger
 from .hub import (
     TELEMETRY_JSONL,
@@ -78,6 +82,8 @@ __all__ = [
     "GoodputLedger",
     "GOODPUT_FILENAME",
     "CrossHostAggregator",
+    "AggregationDisabled",
+    "DISABLED_SENTINEL",
     "TraceRecorder",
     "TELEMETRY_JSONL",
     "TRACE_FILENAME",

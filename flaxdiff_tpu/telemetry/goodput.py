@@ -57,15 +57,22 @@ class GoodputLedger:
             try:
                 with open(path, "r", encoding="utf-8") as f:
                     prior = json.load(f)
-                self._prior_productive = float(prior.get("productive_s", 0.0))
-                self._prior_badput = {
+                prior_productive = float(prior.get("productive_s", 0.0))
+                prior_badput = {
                     str(k): float(v)
                     for k, v in dict(prior.get("badput_s", {})).items()}
-                self.incarnation = int(prior.get("incarnations", 0)) + 1
-            except (json.JSONDecodeError, ValueError, OSError):
+                incarnation = int(prior.get("incarnations", 0)) + 1
+            except (json.JSONDecodeError, ValueError, TypeError, OSError):
                 # a torn write from a crashed incarnation: start a fresh
-                # account rather than refuse to train
-                self.incarnation = 1
+                # account rather than refuse to train. Parsed into
+                # locals so a partial parse (productive_s readable,
+                # badput_s corrupt) cannot leave prior productive time
+                # with zeroed badput — all-or-nothing.
+                pass
+            else:
+                self._prior_productive = prior_productive
+                self._prior_badput = prior_badput
+                self.incarnation = incarnation
 
     # -- recording -----------------------------------------------------------
     def record_productive(self, seconds: float) -> None:

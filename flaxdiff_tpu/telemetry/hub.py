@@ -22,7 +22,7 @@ the process-global hub (`global_telemetry()`); tests scope one with
 
 Dependency direction: telemetry imports nothing from trainer/ or
 data/; the Transport it aggregates over is duck-typed (resilience's
-BarrierTimeout is imported lazily only to classify a failed round).
+event log is imported lazily only to record a failed round).
 """
 from __future__ import annotations
 
@@ -143,22 +143,25 @@ class Telemetry:
                   step: Optional[int] = None
                   ) -> Optional[Dict[str, Dict[str, float]]]:
         """Pod-wide reduction of this host's metrics; rank 0 writes the
-        flattened stats as a `pod_metrics` JSONL record. A timed-out
-        round (dead peer) disables further aggregation for this hub and
-        records a resilience event — metrics must never kill a run."""
+        flattened stats as a `pod_metrics` JSONL record. ANY failed
+        round (timed-out gather on a dead peer, malformed payload,
+        transport error) disables further aggregation for this hub and
+        records a `telemetry_lost` resilience event — metrics must
+        never kill a run, so nothing is re-raised. The disabled
+        aggregator keeps publishing a non-blocking tombstone each round
+        (see CrossHostAggregator), so peers disable on their next
+        gather instead of stalling a full timeout per log cadence."""
         if self.aggregator is None:
             return None
         try:
             stats = self.aggregator.aggregate(metrics)
-        except Exception as e:  # noqa: BLE001 — classified below
-            from ..resilience.coordination import BarrierTimeout
+        except Exception as e:  # noqa: BLE001 — degrade, never die
             from ..resilience.events import record_event
             record_event("telemetry_lost", "telemetry.aggregate",
                          detail=f"{type(e).__name__}: {e}", step=step)
-            self.aggregator = None
-            if not isinstance(e, BarrierTimeout):
-                raise
             return None
+        if stats is None:       # disabled earlier: tombstone offered,
+            return None         # event already recorded — stay quiet
         if self.aggregator.process_index == 0:
             rec: Dict[str, object] = {"type": "pod_metrics",
                                       "world": self.aggregator.world_size}

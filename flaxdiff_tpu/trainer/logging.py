@@ -46,34 +46,40 @@ MAX_INLINE_SEQ = 64
 
 
 def _coerce_value(v):
-    """JSON-serializable form of one metric value, or None if the value
-    is not representable as a (small) metric. Scalars coerce as before;
-    small numeric sequences (lists/tuples/arrays <= MAX_INLINE_SEQ
-    elements) serialize as lists; dicts coerce per-entry one level deep
-    (None entries dropped from the sub-dict)."""
+    """`(coerced, dropped)`: the JSON-serializable form of one metric
+    value (None when the value is not representable as a (small)
+    metric) plus the number of entries lost at ANY depth, so sub-dict
+    losses feed the `telemetry/dropped_keys` counter too. Scalars
+    coerce as before; small numeric sequences (lists/tuples/arrays <=
+    MAX_INLINE_SEQ elements) serialize as lists; dicts coerce per-entry
+    one level deep (None entries dropped from the sub-dict)."""
     if isinstance(v, (str, bool, type(None))):
-        return v
+        return v, 0
     if isinstance(v, numbers.Integral):
-        return int(v)                    # covers np.int32/int64
+        return int(v), 0                 # covers np.int32/int64
     if isinstance(v, numbers.Real):
-        return float(v)                  # covers np.float32/float64
+        return float(v), 0               # covers np.float32/float64
     if isinstance(v, dict):
-        out = {}
+        out, dropped = {}, 0
         for k, sub in v.items():
-            c = _coerce_value(sub)
+            c, d = _coerce_value(sub)
+            dropped += d
             if c is not None or sub is None:
                 out[str(k)] = c
-        return out if out else None
+        if out:
+            return out, dropped
+        # nothing survived: the key itself vanishes — count at least 1
+        return None, max(dropped, 1)
     if isinstance(v, (list, tuple)) or type(v).__name__ == "ndarray":
         import numpy as np
         try:
             arr = np.asarray(v)
         except Exception:  # noqa: BLE001 — ragged/object input: drop
-            return None
+            return None, 1
         if arr.dtype.kind in "biuf" and arr.size <= MAX_INLINE_SEQ:
-            return arr.tolist()
-        return None
-    return None
+            return arr.tolist(), 0
+        return None, 1
+    return None, 1
 
 
 class JsonlLogger:
@@ -83,7 +89,8 @@ class JsonlLogger:
     sample galleries, general_diffusion_trainer.py:521-558).
 
     Values serialize per `_coerce_value`: scalars and SMALL numeric
-    sequences/dicts land in the stream; anything else increments the
+    sequences/dicts land in the stream; anything else — including
+    entries lost INSIDE a surviving sub-dict — increments the
     `telemetry/dropped_keys` counter on the global telemetry hub instead
     of vanishing invisibly (the pre-telemetry behavior silently dropped
     every list/dict/array value)."""
@@ -100,9 +107,9 @@ class JsonlLogger:
             rec["step"] = int(step)
         dropped = 0
         for k, v in data.items():
-            c = _coerce_value(v)
+            c, d = _coerce_value(v)
+            dropped += d                 # counts nested losses too
             if c is None and v is not None:
-                dropped += 1
                 continue
             rec[k] = c
         if dropped:

@@ -465,6 +465,50 @@ def test_stale_epoch_set_poisons_consensus(tmp_path):
         c0.consensus_restore_step([2, 4])
 
 
+def test_agree_epoch_converges_divergent_incarnations(tmp_path):
+    """goodput.json is written by process 0 only, so with a host-local
+    telemetry dir (or a torn read) local incarnations diverge — rank 0
+    at N+1, others stuck at 1. agree_epoch broadcasts rank 0's value so
+    every host tags with the SAME epoch; coordinators built on the
+    agreed value then commit normally, where divergent tags would have
+    aborted every round forever."""
+    ev = R.EventLog("t")
+    transports = R.InMemoryTransport.make_world(2)
+    local = [3, 1]                 # rank 1 never saw goodput.json
+    agreed = _both(
+        lambda: R.agree_epoch(transports[0], local[0], timeout=5.0,
+                              event_log=ev),
+        lambda: R.agree_epoch(transports[1], local[1], timeout=5.0,
+                              event_log=ev))
+    assert agreed == [3, 3]        # rank 0 is authoritative
+    adopted = ev.events("epoch_adopted")
+    assert len(adopted) == 1 and "1" in adopted[0].detail
+    # the agreed epoch makes the world commit-capable
+    c0, c1 = [R.RestartCoordinator(t, barrier_timeout=5.0, event_log=ev,
+                                   epoch=e)
+              for t, e in zip(transports, agreed)]
+    led = R.StepLedger(str(tmp_path))
+    assert _both(lambda: c0.commit(4, led),
+                 lambda: c1.commit(4, led)) == [4, 4]
+    assert led.committed_steps() == [4]
+
+
+def test_divergent_epochs_abort_every_round(tmp_path):
+    """The failure mode agree_epoch exists to prevent: coordinators
+    tagged with different epochs abort every commit round."""
+    ev = R.EventLog("t")
+    t0, t1 = R.InMemoryTransport.make_world(2)
+    c0 = R.RestartCoordinator(t0, barrier_timeout=5.0, event_log=ev,
+                              epoch=2)
+    c1 = R.RestartCoordinator(t1, barrier_timeout=5.0, event_log=ev,
+                              epoch=1)
+    led = R.StepLedger(str(tmp_path))
+    assert _both(lambda: c0.commit(4, led),
+                 lambda: c1.commit(4, led)) == [None, None]
+    assert led.committed_steps() == []
+    assert ev.count("commit_aborted", "ckpt.commit") == 2
+
+
 def test_untagged_payload_rejected(tmp_path):
     """A foreign writer (pre-epoch binary, corrupted payload) that
     gathers as a raw value — not a tagged dict — is treated exactly

@@ -186,6 +186,19 @@ class TestGoodputLedger:
         assert g.incarnation == 1
         assert g.totals()["productive_s"] == 0.0
 
+    def test_partially_corrupt_file_starts_fully_fresh(self, tmp_path):
+        """Valid JSON with a corrupt badput_s must not keep the prior
+        productive seconds while zeroing badput — that would inflate
+        goodput_fraction cumulatively. Fresh means ALL fields fresh."""
+        path = tmp_path / "goodput.json"
+        path.write_text(json.dumps({"productive_s": 500.0,
+                                    "badput_s": {"compile": "garbage"},
+                                    "incarnations": 7}))
+        g = T.GoodputLedger(str(path))
+        assert g.incarnation == 1
+        t = g.totals()
+        assert t["productive_s"] == 0.0 and t["badput_s"] == {}
+
     def test_nonzero_rank_never_writes(self, tmp_path):
         path = str(tmp_path / "goodput.json")
         g = T.GoodputLedger(path, process_index=3)
@@ -235,9 +248,55 @@ def test_hub_aggregate_timeout_degrades_not_dies():
     ev = R.EventLog("t")
     with R.use_event_log(ev):
         assert hub.aggregate({"x": 1.0}) is None
-    assert hub.aggregator is None
+    assert hub.aggregator.disabled
     assert ev.count("telemetry_lost", "telemetry.aggregate") == 1
     assert hub.aggregate({"x": 1.0}) is None      # stays off, stays quiet
+
+
+def test_hub_aggregate_swallows_non_timeout_failures():
+    """'Metrics must never kill a run' covers EVERY failure mode, not
+    just BarrierTimeout: a malformed peer payload or transport bug
+    records telemetry_lost and degrades instead of raising into fit."""
+    class BrokenTransport(R.InMemoryTransport):
+        def allgather_json(self, name, obj, timeout):
+            raise TypeError("malformed peer payload")
+
+    t0 = BrokenTransport.make_world(1)[0]
+    hub = T.Telemetry(aggregator=T.CrossHostAggregator(t0, timeout=0.2))
+    ev = R.EventLog("t")
+    with R.use_event_log(ev):
+        assert hub.aggregate({"x": 1.0}) is None      # no raise
+        assert hub.aggregator.disabled
+        assert hub.aggregate({"x": 1.0}) is None      # stays quiet
+    events = ev.events(kind="telemetry_lost")
+    assert len(events) == 1 and "TypeError" in events[0].detail
+
+
+def test_disable_tombstone_propagates_without_stall():
+    """A disabled host publishes a non-blocking tombstone each round;
+    the surviving peer's NEXT gather sees it and disables too instead
+    of blocking for the full timeout at every log cadence."""
+    t0, t1 = R.InMemoryTransport.make_world(2)
+    hub0 = T.Telemetry(aggregator=T.CrossHostAggregator(t0, timeout=5.0))
+    hub1 = T.Telemetry(aggregator=T.CrossHostAggregator(t1, timeout=5.0))
+    hub0.aggregator.disabled = True           # host 0 failed earlier
+    ev = R.EventLog("t")
+    with R.use_event_log(ev):
+        res0 = [None]
+        th = threading.Thread(
+            target=lambda: res0.__setitem__(0, hub0.aggregate({"x": 1.0})))
+        th.start()
+        t_start = time.perf_counter()
+        assert hub1.aggregate({"x": 2.0}) is None
+        elapsed = time.perf_counter() - t_start
+        th.join()
+    assert res0[0] is None
+    assert hub1.aggregator.disabled           # propagated in one round
+    assert elapsed < 2.0                      # no 5s timeout stall
+    assert ev.count("telemetry_lost", "telemetry.aggregate") == 1
+    # both sides now fully degraded and non-blocking
+    assert hub0.aggregate({"x": 1.0}) is None
+    assert hub1.aggregate({"x": 2.0}) is None
 
 
 # -- tracing ------------------------------------------------------------------
@@ -451,6 +510,24 @@ def test_jsonl_logger_serializes_small_sequences_and_counts_drops(tmp_path):
     assert rec["nested"] == {"a": 1.5, "b": 2}
     assert "huge" not in rec and "opaque" not in rec
     assert hub.counter("telemetry/dropped_keys").value == 2
+
+
+def test_jsonl_logger_counts_nested_dict_drops(tmp_path):
+    """'Never silently dropped' must hold one level down too: entries
+    lost inside a surviving sub-dict count toward dropped_keys."""
+    from flaxdiff_tpu.trainer.logging import JsonlLogger
+    hub = T.Telemetry(enabled=False)
+    with T.use_telemetry(hub):
+        lg = JsonlLogger(str(tmp_path / "log.jsonl"))
+        lg.log({"nested": {"keep": 1.0, "lost": object(),
+                           "huge": np.zeros(10_000)},
+                "all_lost": {"a": object(), "b": object()}}, step=1)
+        lg.finish()
+    rec = json.loads(open(tmp_path / "log.jsonl").read())
+    assert rec["nested"] == {"keep": 1.0}
+    assert "all_lost" not in rec
+    # 2 inside the surviving sub-dict + 2 inside the vanished one
+    assert hub.counter("telemetry/dropped_keys").value == 4
 
 
 def test_profiler_trace_failure_records_event(monkeypatch, tmp_path):

@@ -471,16 +471,24 @@ def main(argv=None):
             args.coordinated_restart == "auto"
             and jax.process_count() > 1):
         from flaxdiff_tpu.resilience.coordination import (
-            RestartCoordinator, default_transport)
+            RestartCoordinator, agree_epoch, default_transport)
+        coord_transport = default_transport()
+        # epoch-tagged vote payloads: the goodput ledger's incarnation
+        # count IS the job-incarnation number, so a stale voter from a
+        # previous life aborts the round instead of corrupting it
+        # (docs/RESILIENCE.md). goodput.json is written by process 0
+        # only, so non-0 hosts (host-local --telemetry_dir, torn read)
+        # may hold a different local count — broadcast rank 0's value so
+        # every host tags with the SAME epoch; divergent tags would
+        # abort every future round.
         coordinator = RestartCoordinator(
-            default_transport(),
+            coord_transport,
             barrier_timeout=args.commit_barrier_timeout,
-            # epoch-tagged vote payloads: the goodput ledger's
-            # incarnation count IS the job-incarnation number, so a
-            # stale voter from a previous life aborts the round instead
-            # of corrupting it (docs/RESILIENCE.md)
-            epoch=(telemetry.goodput.incarnation
-                   if telemetry is not None else 0))
+            epoch=agree_epoch(
+                coord_transport,
+                (telemetry.goodput.incarnation
+                 if telemetry is not None else 0),
+                timeout=args.commit_barrier_timeout))
     ckpt = Checkpointer(args.checkpoint_dir, coordinator=coordinator)
     trainer = DiffusionTrainer(
         apply_fn=apply_fn, init_fn=init_fn, tx=tx, schedule=schedule,
