@@ -14,6 +14,10 @@ Known sites (the framework's barriers; plans may name new ones freely):
     data.fetch    default_url_fetcher / OnlineStreamingDataLoader._load_one
     data.stall    loader worker: injects a sleep (wedged-loader chaos)
     step.nan      DiffusionTrainer.fit: poisons the next loss readback
+    numerics.nan  DiffusionTrainer.fit: corrupts ONE top-level module's
+                  params with NaNs (first module in sorted key order) —
+                  the numerics monitor must detect it and the
+                  provenance pass must name the module
     host.sigterm  DiffusionTrainer.fit: SIGTERMs the process at a step
     coord.local_valid  Checkpointer.locally_valid_steps: drops the
                   newest step from THIS host's consensus-restore input

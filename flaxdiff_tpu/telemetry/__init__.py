@@ -25,6 +25,15 @@ the ROADMAP depends on — you cannot speed up what you cannot attribute:
   tracing     TraceRecorder: host-side spans (fit phases, checkpoint
               rounds, sampler loops, recovery paths) as Chrome
               trace-event JSON, loadable in Perfetto
+  numerics    training-health: in-graph NumericsConfig/numerics_aux
+              (per-module grad/param norms, update ratios, non-finite
+              counts inside the jitted step at a cadence) + host-side
+              AnomalyDetector (EMA z-score, hard non-finite/floor
+              triggers, warn|skip_step|rollback actions) + NaN
+              provenance helpers that name the module that blew up
+  memory      MemoryMonitor: HBM gauges from device.memory_stats()
+              (bytes-in-use, peak, per-step watermark, utilization),
+              self-disabling on backends without allocator stats
   hub         Telemetry: the bundle the other layers talk to, plus the
               process-global default (`global_telemetry`) for layers
               with no plumbing
@@ -51,6 +60,7 @@ from .hub import (
     set_global_telemetry,
     use_telemetry,
 )
+from .memory import MemoryMonitor
 from .metrics import (
     DEFAULT_BUCKET_BOUNDS,
     Counter,
@@ -60,6 +70,21 @@ from .metrics import (
     LoggerExporter,
     MetricsRegistry,
     PrometheusTextfileExporter,
+)
+from .numerics import (
+    ANOMALY_ACTIONS,
+    Anomaly,
+    AnomalyConfig,
+    AnomalyDetector,
+    NumericsConfig,
+    flatten_aux,
+    nonfinite_modules,
+    numerics_aux,
+    probe_aux,
+    top_level_modules,
+    tree_l2_norm,
+    tree_nonfinite_count,
+    unwrap_module_tree,
 )
 from .phases import PHASES, StepPhaseTimer
 from .tracing import TraceRecorder
@@ -87,4 +112,18 @@ __all__ = [
     "TraceRecorder",
     "TELEMETRY_JSONL",
     "TRACE_FILENAME",
+    "NumericsConfig",
+    "numerics_aux",
+    "probe_aux",
+    "flatten_aux",
+    "nonfinite_modules",
+    "top_level_modules",
+    "tree_l2_norm",
+    "tree_nonfinite_count",
+    "unwrap_module_tree",
+    "AnomalyConfig",
+    "AnomalyDetector",
+    "Anomaly",
+    "ANOMALY_ACTIONS",
+    "MemoryMonitor",
 ]

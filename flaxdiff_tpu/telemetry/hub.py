@@ -121,13 +121,34 @@ class Telemetry:
             self.recorder.instant(name, cat=cat, args=args)
 
     # -- export --------------------------------------------------------------
+    def write_record(self, record: Dict[str, object]) -> None:
+        """One raw typed record into the JSONL stream (a no-op on the
+        disabled hub, which has no exporters)."""
+        for ex in self.exporters:
+            ex.write(record)
+
     def record_step(self, phases: Dict[str, float]) -> None:
         """One per-step phase row into the raw JSONL stream."""
         rec = {"type": "step_phases",
                "step": int(phases.get("step", -1))}
         rec.update({k: v for k, v in phases.items() if k != "step"})
-        for ex in self.exporters:
-            ex.write(rec)
+        self.write_record(rec)
+
+    def record_numerics(self, flat_aux: Dict[str, float],
+                        step: Optional[int] = None) -> None:
+        """One per-cadence training-health row (`type: "numerics"`) into
+        the raw stream, and the global/summary series into registry
+        gauges so the Prometheus textfile carries the latest values.
+        Per-module series stay JSONL-only — module count times four
+        stats would chew the registry's series budget on big models."""
+        rec: Dict[str, object] = {"type": "numerics"}
+        if step is not None:
+            rec["step"] = int(step)
+        rec.update(flat_aux)
+        self.write_record(rec)
+        for name, v in flat_aux.items():
+            if not name.startswith("numerics/module/"):
+                self.registry.gauge(name).set(v)
 
     def export(self, step: Optional[int] = None,
                extra: Optional[Dict[str, float]] = None) -> None:
@@ -139,22 +160,45 @@ class Telemetry:
         for ex in self.exporters:
             ex.export(snap, step=step)
 
+    def _goodput_contribution(self) -> Dict[str, float]:
+        """THIS host's goodput account as aggregatable scalars. The
+        persisted `goodput.json` is process 0's clock alone (it is the
+        only writer); gathering every host's in-memory counters is what
+        makes `pod/goodput/*` a pod-level fact — the spread of
+        productive seconds across hosts IS the straggler/stall skew the
+        persisted account cannot show."""
+        prod, bad = self.goodput.raw_counters()
+        out = {"goodput/productive_s": prod}
+        for bucket, v in bad.items():
+            out[f"goodput/badput/{bucket}_s"] = v
+        total = prod + sum(bad.values())
+        if total > 0:
+            out["goodput/fraction"] = prod / total
+        return out
+
     def aggregate(self, metrics: Dict[str, float],
                   step: Optional[int] = None
                   ) -> Optional[Dict[str, Dict[str, float]]]:
-        """Pod-wide reduction of this host's metrics; rank 0 writes the
-        flattened stats as a `pod_metrics` JSONL record. ANY failed
-        round (timed-out gather on a dead peer, malformed payload,
-        transport error) disables further aggregation for this hub and
-        records a `telemetry_lost` resilience event — metrics must
-        never kill a run, so nothing is re-raised. The disabled
-        aggregator keeps publishing a non-blocking tombstone each round
-        (see CrossHostAggregator), so peers disable on their next
-        gather instead of stalling a full timeout per log cadence."""
+        """Pod-wide reduction of this host's metrics — merged with this
+        host's goodput counters, so the pod report carries
+        `pod/goodput/*` rows (no longer proc-0's clock alone). Rank 0
+        writes the flattened stats as a `pod_metrics` JSONL record AND
+        mirrors them into registry gauges, so the Prometheus textfile
+        exposes `pod/<metric>/<stat>` for alerting
+        (examples/alerting.rules.yml). ANY failed round (timed-out
+        gather on a dead peer, malformed payload, transport error)
+        disables further aggregation for this hub and records a
+        `telemetry_lost` resilience event — metrics must never kill a
+        run, so nothing is re-raised. The disabled aggregator keeps
+        publishing a non-blocking tombstone each round (see
+        CrossHostAggregator), so peers disable on their next gather
+        instead of stalling a full timeout per log cadence."""
         if self.aggregator is None:
             return None
+        contribution = dict(metrics)
+        contribution.update(self._goodput_contribution())
         try:
-            stats = self.aggregator.aggregate(metrics)
+            stats = self.aggregator.aggregate(contribution)
         except Exception as e:  # noqa: BLE001 — degrade, never die
             from ..resilience.events import record_event
             record_event("telemetry_lost", "telemetry.aggregate",
@@ -163,13 +207,15 @@ class Telemetry:
         if stats is None:       # disabled earlier: tombstone offered,
             return None         # event already recorded — stay quiet
         if self.aggregator.process_index == 0:
+            flat = CrossHostAggregator.flatten(stats)
             rec: Dict[str, object] = {"type": "pod_metrics",
                                       "world": self.aggregator.world_size}
             if step is not None:
                 rec["step"] = int(step)
-            rec.update(CrossHostAggregator.flatten(stats))
-            for ex in self.exporters:
-                ex.write(rec)
+            rec.update(flat)
+            self.write_record(rec)
+            for name, v in flat.items():
+                self.registry.gauge(name).set(v)
         return stats
 
     # -- lifecycle -----------------------------------------------------------

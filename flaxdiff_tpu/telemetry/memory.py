@@ -1,0 +1,97 @@
+"""HBM memory gauges: `device.memory_stats()` sampled into the metrics
+registry.
+
+An OOM on a pod is the one failure the resilience layer cannot recover
+(the process dies inside XLA); the only defense is seeing the watermark
+climb BEFORE the allocation that kills the run — fragmentation from a
+leaked reference, an eval pass that doubles live buffers, a checkpoint
+restore holding two copies of the state. `MemoryMonitor` samples every
+local device's allocator stats and reduces them to a handful of
+bounded-cardinality series:
+
+    memory/bytes_in_use          max over local devices (HBM is
+                                 per-chip; the fullest chip OOMs first)
+    memory/peak_bytes_in_use     max of the allocator's own peak
+    memory/bytes_limit           min per-device capacity
+    memory/utilization           bytes_in_use / bytes_limit
+    memory/step_watermark_bytes  max bytes_in_use seen by `sample()`
+                                 since the last `record()` — the
+                                 per-step high-water mark when sampled
+                                 more often than it is exported
+    memory/devices               local devices reporting stats
+
+Backends without `memory_stats()` (CPU returns None; some plugins
+raise) disable the monitor after the first empty sample — later calls
+are a single attribute read, so leaving the monitor wired in the
+trainer costs nothing off-TPU.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+log = logging.getLogger("flaxdiff_tpu.telemetry")
+
+
+class MemoryMonitor:
+    """Bounded-cardinality HBM gauge sampler (host-side, no device
+    work — allocator stats are a local C++ call)."""
+
+    def __init__(self, devices: Optional[List] = None):
+        self._devices = devices
+        self.disabled = False
+        self._watermark = 0.0
+
+    def _device_stats(self) -> List[Dict[str, float]]:
+        if self._devices is None:
+            import jax
+            self._devices = jax.local_devices()
+        out = []
+        for d in self._devices:
+            try:
+                stats = d.memory_stats()
+            except Exception as e:  # noqa: BLE001 — plugin backends may
+                # raise instead of returning None; one debug line, then
+                # the disabled latch makes this a no-op forever
+                log.debug("memory_stats() failed on %r: %s", d, e)
+                continue
+            if stats:
+                out.append(stats)
+        return out
+
+    def sample(self) -> Dict[str, float]:
+        """One flat gauge snapshot; `{}` on backends without stats
+        (after which the monitor latches disabled)."""
+        if self.disabled:
+            return {}
+        per = self._device_stats()
+        if not per:
+            self.disabled = True
+            log.debug("no device reports memory_stats(); "
+                      "HBM gauges disabled for this process")
+            return {}
+        in_use = max(float(s.get("bytes_in_use", 0.0)) for s in per)
+        peak = max(float(s.get("peak_bytes_in_use", 0.0)) for s in per)
+        limits = [float(s["bytes_limit"]) for s in per if "bytes_limit" in s]
+        self._watermark = max(self._watermark, in_use)
+        out = {
+            "memory/bytes_in_use": in_use,
+            "memory/peak_bytes_in_use": peak,
+            "memory/step_watermark_bytes": self._watermark,
+            "memory/devices": float(len(per)),
+        }
+        if limits:
+            limit = min(limits)
+            out["memory/bytes_limit"] = limit
+            if limit > 0:
+                out["memory/utilization"] = in_use / limit
+        return out
+
+    def record(self, registry) -> Dict[str, float]:
+        """Sample into `registry` gauges and reset the watermark window.
+        Returns the snapshot (empty when disabled)."""
+        stats = self.sample()
+        for name, value in stats.items():
+            registry.gauge(name).set(value)
+        self._watermark = 0.0
+        return stats

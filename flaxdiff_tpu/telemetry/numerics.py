@@ -1,0 +1,409 @@
+"""In-graph numerics telemetry + host-side anomaly detection: the
+model-health half of the observability layer.
+
+PR 3 answered "where did the wall-clock go"; this module answers "is
+the MODEL healthy" — the signal a diverging diffusion run emits long
+before the scalar loss goes non-finite. Three pieces:
+
+  numerics_aux      computed INSIDE the jitted train step (train_step.py
+                    calls it when built with a NumericsConfig): global
+                    and per-top-level-module gradient norms, param
+                    norms, update/param ratios, gradient non-finite
+                    counts, and the loss — returned as a compact pytree
+                    of scalars. The trainer compiles TWO step programs
+                    and dispatches the monitored one only every
+                    `numerics_cadence` steps, so off-cadence steps run
+                    the exact unmonitored program and pay zero extra
+                    device work.
+  AnomalyDetector   host-side rolling EMA + one-sided z-score on loss
+                    and gradient norm, plus hard triggers (non-finite
+                    gradients/loss, the abnormal-loss floor). Anomalies
+                    land as `anomaly` resilience events at
+                    `numerics.<kind>` sites, `numerics/*` counters, and
+                    `numerics_anomaly` JSONL records; the configured
+                    action (`warn` | `skip_step` | `rollback`) is
+                    executed by the trainer.
+  provenance        per-module non-finite localization: the trainer
+                    re-runs one gradient pass (make_grad_probe in
+                    train_step.py) and `nonfinite_modules` names the
+                    modules whose params or grads hold non-finite
+                    values — "which module blew up", not just "the loss
+                    is NaN".
+
+`skip_step` is implemented IN-GRAPH (train_step gates the param /
+opt-state / EMA update with `jnp.where` when the step's gradients or
+loss are non-finite — the same mechanism as the fp16 DynamicScale
+overflow path), so a poisoned batch can never contaminate state even
+though the anomaly is only *reported* at the next host readback.
+Z-score (soft) anomalies under `skip_step` degrade to `warn` — the
+state is already donated by the time the host can judge a spike.
+
+Dependency direction: telemetry imports nothing from trainer/; the
+train step imports THIS module for the aux computation (pure jnp, no
+hub access in-graph).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class NumericsConfig:
+    """Static config closed over by the monitored train step."""
+
+    # per-top-level-module breakdown (flax params dict keys); flat-param
+    # states have no module structure — the trainer disables this there
+    per_module: bool = True
+    # gate the param/opt/EMA update in-graph when this step's gradients
+    # or loss are non-finite (the `skip_step` anomaly action)
+    skip_nonfinite: bool = False
+
+
+# -- in-graph computation (pure jnp; called inside the jitted step) -----------
+
+def tree_l2_norm(tree) -> jax.Array:
+    """Global L2 norm over every leaf, accumulated in f32."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    total = sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+    return jnp.sqrt(total)
+
+
+def tree_nonfinite_count(tree) -> jax.Array:
+    """Number of non-finite elements across every leaf (int32)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.int32)
+    return sum(jnp.sum(~jnp.isfinite(x.astype(jnp.float32))).astype(jnp.int32)
+               for x in leaves)
+
+
+def unwrap_module_tree(tree) -> Tuple[object, List[str]]:
+    """Descend single-key wrapper levels whose only value is a dict OF
+    dicts (`{"params": {"down_0": ..., "up_0": ...}}` — the
+    `model.init` envelope the CLI passes through verbatim); returns the
+    module-level tree and the wrapper-key path. A single-module tree
+    holding leaf arrays (`{"Conv_0": {"kernel": ...}}`) is NOT
+    descended — kernel/bias are not modules."""
+    path: List[str] = []
+    while (isinstance(tree, dict) and len(tree) == 1
+           and isinstance(next(iter(tree.values())), dict)
+           and all(isinstance(v, dict)
+                   for v in next(iter(tree.values())).values())):
+        key = next(iter(tree))
+        path.append(key)
+        tree = tree[key]
+    return tree, path
+
+
+def top_level_modules(tree) -> Dict[str, object]:
+    """`{module_name: subtree}` for a flax-style params dict (wrapper
+    levels descended, see unwrap_module_tree); empty for non-dict
+    states (flat-param vectors have no module structure)."""
+    tree, _ = unwrap_module_tree(tree)
+    if isinstance(tree, dict):
+        return dict(tree)
+    return {}
+
+
+def numerics_aux(loss: jax.Array, grads, params_before, params_after,
+                 per_module: bool = True,
+                 eps: float = 1e-12) -> Dict[str, object]:
+    """The compact auxiliary pytree the monitored train step returns.
+
+    All leaves are scalars; `update_ratio` is ||after - before|| /
+    ||before|| — the effective-learning-rate signal whose drift
+    precedes most divergences. Keys mirror the exported metric names
+    (without the `numerics/` prefix)."""
+    param_norm_before = tree_l2_norm(params_before)
+    update_norm = tree_l2_norm(jax.tree_util.tree_map(
+        lambda a, b: a.astype(jnp.float32) - b.astype(jnp.float32),
+        params_after, params_before))
+    aux: Dict[str, object] = {
+        "loss": loss.astype(jnp.float32),
+        "grad_norm": tree_l2_norm(grads),
+        "param_norm": tree_l2_norm(params_after),
+        "update_norm": update_norm,
+        "update_ratio": update_norm / (param_norm_before + eps),
+        "grad_nonfinite": tree_nonfinite_count(grads),
+    }
+    if per_module:
+        modules = {}
+        grads_by_mod = top_level_modules(grads)
+        before_by_mod = top_level_modules(params_before)
+        after_by_mod = top_level_modules(params_after)
+        for name in sorted(grads_by_mod):
+            g = grads_by_mod[name]
+            b = before_by_mod.get(name)
+            a = after_by_mod.get(name)
+            mod = {"grad_norm": tree_l2_norm(g),
+                   "grad_nonfinite": tree_nonfinite_count(g)}
+            if a is not None and b is not None:
+                mod["param_norm"] = tree_l2_norm(a)
+                up = tree_l2_norm(jax.tree_util.tree_map(
+                    lambda x, y: x.astype(jnp.float32)
+                    - y.astype(jnp.float32), a, b))
+                mod["update_ratio"] = up / (tree_l2_norm(b) + eps)
+            modules[name] = mod
+        if modules:
+            aux["module"] = modules
+    return aux
+
+
+def probe_aux(loss: jax.Array, grads, params) -> Dict[str, object]:
+    """Provenance pytree for make_grad_probe: per-module non-finite
+    counts for both the gradients and the params themselves, so the
+    host can name the module where the non-finite values LIVE (params
+    poisoned by a previous bad update) or ORIGINATE (grads)."""
+    modules = {}
+    grads_by_mod = top_level_modules(grads)
+    params_by_mod = top_level_modules(params)
+    for name in sorted(set(grads_by_mod) | set(params_by_mod)):
+        modules[name] = {
+            "grad_nonfinite": tree_nonfinite_count(
+                grads_by_mod.get(name, ())),
+            "param_nonfinite": tree_nonfinite_count(
+                params_by_mod.get(name, ())),
+        }
+    return {"loss": loss.astype(jnp.float32),
+            "grad_nonfinite": tree_nonfinite_count(grads),
+            "param_nonfinite": tree_nonfinite_count(params),
+            "module": modules}
+
+
+# -- host-side flattening ------------------------------------------------------
+
+def flatten_aux(aux: Dict[str, object],
+                prefix: str = "numerics") -> Dict[str, float]:
+    """Device aux pytree -> flat `{metric_name: float}` export view
+    (`numerics/grad_norm`, `numerics/module/<module>/grad_norm`, ...).
+    Call on a `jax.device_get` result — this is the one host sync a
+    cadence step pays."""
+    host = jax.device_get(aux)
+    out: Dict[str, float] = {}
+    for key, val in host.items():
+        if key == "module":
+            for mod, stats in val.items():
+                for stat, v in stats.items():
+                    out[f"{prefix}/module/{mod}/{stat}"] = float(v)
+        else:
+            out[f"{prefix}/{key}"] = float(val)
+    return out
+
+
+def nonfinite_modules(probe: Dict[str, object]) -> List[str]:
+    """The provenance verdict from a make_grad_probe result: the
+    module(s) where the non-finite values LIVE.
+
+    Localization prefers `param_nonfinite` — once the loss is NaN,
+    backprop poisons EVERY module's gradients, so per-module grad
+    counts alone cannot distinguish the corrupt module from its
+    victims; non-finite params name the culprit exactly. Only when all
+    params are clean (a bad batch / activation overflow) does the
+    verdict fall back to the grad counts — a broad answer, but "every
+    module's grads are non-finite, params clean" itself says the
+    poison entered through the data path."""
+    host = jax.device_get(probe)
+    modules = sorted(host.get("module", {}).items())
+    in_params = [name for name, stats in modules
+                 if float(stats.get("param_nonfinite", 0)) > 0]
+    if in_params:
+        return in_params
+    return [name for name, stats in modules
+            if float(stats.get("grad_nonfinite", 0)) > 0]
+
+
+# -- anomaly detection ---------------------------------------------------------
+
+ANOMALY_ACTIONS = ("warn", "skip_step", "rollback")
+
+
+@dataclasses.dataclass(frozen=True)
+class AnomalyConfig:
+    """Host-side detector tuning.
+
+    `window` sizes the EMA (alpha = 2 / (window + 1)) behind the
+    z-score; `min_steps` observations must accumulate before soft
+    (z-score) triggers arm — hard triggers (non-finite, the
+    abnormal-loss floor) always fire. `zscore` is one-sided: only
+    upward spikes of loss / grad-norm are anomalies (a sudden DROP is
+    not instability)."""
+
+    zscore: float = 6.0
+    window: int = 50
+    min_steps: int = 8
+    # loss <= floor, NaN or Inf is abnormal (the trainer's historical
+    # rollback trigger, reference simple_trainer.py:542-575)
+    abnormal_loss_floor: float = 1e-8
+    action: str = "warn"
+
+    def __post_init__(self):
+        if self.action not in ANOMALY_ACTIONS:
+            raise ValueError(f"anomaly action {self.action!r} not in "
+                             f"{ANOMALY_ACTIONS}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Anomaly:
+    kind: str           # nonfinite_grad | nonfinite_loss | abnormal_loss
+    #                   # | loss_spike | grad_spike
+    metric: str         # the series that triggered (loss / grad_norm / ...)
+    value: float
+    step: Optional[int] = None
+    zscore: Optional[float] = None
+
+    @property
+    def hard(self) -> bool:
+        """Hard anomalies (non-finite / floor) always justify the
+        configured action; soft (z-score) ones are advisory under
+        `skip_step` (the state is already donated when the host sees
+        them)."""
+        return self.kind in ("nonfinite_grad", "nonfinite_loss",
+                             "abnormal_loss")
+
+    def detail(self) -> str:
+        z = f" z={self.zscore:.1f}" if self.zscore is not None else ""
+        return f"{self.kind}: {self.metric}={self.value!r}{z}"
+
+
+class _Ewm:
+    """Exponentially weighted mean/variance (West's recurrence)."""
+
+    def __init__(self, alpha: float):
+        self.alpha = alpha
+        self.mean = 0.0
+        self.var = 0.0
+        self.n = 0
+
+    def update(self, v: float) -> None:
+        if self.n == 0:
+            self.mean, self.var = v, 0.0
+        else:
+            d = v - self.mean
+            incr = self.alpha * d
+            self.mean += incr
+            self.var = (1.0 - self.alpha) * (self.var + d * incr)
+        self.n += 1
+
+    def zscore(self, v: float) -> float:
+        if self.n == 0:
+            return 0.0
+        return (v - self.mean) / math.sqrt(self.var + 1e-12)
+
+
+class AnomalyDetector:
+    """Rolling statistics over the per-cadence numerics stream; one
+    instance per fit loop. Emits through the telemetry hub (counters +
+    `numerics_anomaly` raw records) and the resilience event log; the
+    caller (the trainer) executes the configured action."""
+
+    def __init__(self, config: AnomalyConfig = AnomalyConfig(),
+                 telemetry=None, event_log=None):
+        self.config = config
+        self._telemetry = telemetry
+        self._event_log = event_log
+        alpha = 2.0 / (config.window + 1.0)
+        self._loss = _Ewm(alpha)
+        self._grad = _Ewm(alpha)
+        self.anomalies: List[Anomaly] = []
+
+    # lazy hub/log resolution: the process-global defaults may be
+    # swapped by tests between construction and use
+    @property
+    def _tel(self):
+        if self._telemetry is not None:
+            return self._telemetry
+        from .hub import global_telemetry
+        return global_telemetry()
+
+    @property
+    def _events(self):
+        if self._event_log is not None:
+            return self._event_log
+        from ..resilience.events import global_event_log
+        return global_event_log()
+
+    # -- recording -----------------------------------------------------------
+    def _emit(self, anomaly: Anomaly) -> Anomaly:
+        self.anomalies.append(anomaly)
+        tel = self._tel
+        tel.counter("numerics/anomalies").inc()
+        if anomaly.kind.startswith("nonfinite"):
+            tel.counter("numerics/nonfinite_steps").inc()
+        self._events.record("anomaly", f"numerics.{anomaly.kind}",
+                            detail=anomaly.detail(), step=anomaly.step)
+        rec = {"type": "numerics_anomaly", "kind": anomaly.kind,
+               "metric": anomaly.metric, "value": anomaly.value,
+               "action": self.config.action}
+        if anomaly.step is not None:
+            rec["step"] = int(anomaly.step)
+        if anomaly.zscore is not None:
+            rec["zscore"] = anomaly.zscore
+        tel.write_record(rec)
+        tel.instant(f"numerics.{anomaly.kind}", cat="numerics", args=rec)
+        return anomaly
+
+    # -- the hard path (replaces the trainer's ad-hoc loss checks) -----------
+    def abnormal_loss(self, loss: float,
+                      step: Optional[int] = None) -> Optional[Anomaly]:
+        """The historical rollback trigger, now ONE code path for
+        fault-injected and real NaNs: non-finite loss or loss at/below
+        the abnormal floor. Returns the recorded anomaly, else None."""
+        loss = float(loss)
+        if not math.isfinite(loss):
+            return self._emit(Anomaly("nonfinite_loss", "loss", loss,
+                                      step=step))
+        if loss <= self.config.abnormal_loss_floor:
+            return self._emit(Anomaly("abnormal_loss", "loss", loss,
+                                      step=step))
+        return None
+
+    # -- the cadence path ----------------------------------------------------
+    def observe(self, step: int, loss: float, grad_norm: float,
+                grad_nonfinite: float = 0.0) -> List[Anomaly]:
+        """One cadence observation. Hard triggers first (non-finite
+        grads/loss, floor); soft z-score spikes only after `min_steps`
+        healthy observations, and anomalous samples never update the
+        rolling statistics (a spike must not teach the EMA that spikes
+        are normal)."""
+        out: List[Anomaly] = []
+        loss, grad_norm = float(loss), float(grad_norm)
+        if float(grad_nonfinite) > 0:
+            out.append(self._emit(Anomaly(
+                "nonfinite_grad", "grad_nonfinite", float(grad_nonfinite),
+                step=step)))
+        hard_loss = self.abnormal_loss(loss, step=step)
+        if hard_loss is not None:
+            out.append(hard_loss)
+        if out:
+            return out      # poisoned samples stay out of the EMA
+        armed = self._loss.n >= self.config.min_steps
+        lz = self._loss.zscore(loss)
+        gz = self._grad.zscore(grad_norm)
+        if armed and lz > self.config.zscore:
+            out.append(self._emit(Anomaly("loss_spike", "loss", loss,
+                                          step=step, zscore=lz)))
+        if armed and math.isfinite(grad_norm) \
+                and gz > self.config.zscore:
+            out.append(self._emit(Anomaly("grad_spike", "grad_norm",
+                                          grad_norm, step=step, zscore=gz)))
+        if not out:
+            self._loss.update(loss)
+            if math.isfinite(grad_norm):
+                self._grad.update(grad_norm)
+        return out
+
+    def observe_aux(self, step: int,
+                    flat_aux: Dict[str, float]) -> List[Anomaly]:
+        """`observe` from a `flatten_aux` result."""
+        return self.observe(
+            step,
+            loss=flat_aux.get("numerics/loss", float("nan")),
+            grad_norm=flat_aux.get("numerics/grad_norm", float("nan")),
+            grad_nonfinite=flat_aux.get("numerics/grad_nonfinite", 0.0))

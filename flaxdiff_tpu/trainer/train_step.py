@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from ..predictors import PredictionTransform
 from ..schedulers.common import NoiseSchedule, bcast_right
+from ..telemetry.numerics import NumericsConfig, numerics_aux, probe_aux
 from ..typing import Policy, PyTree
 from ..utils import cfg_uncond_splice, normalize_images
 from .train_state import TrainState
@@ -41,26 +42,14 @@ class TrainStepConfig:
     weighted_loss: bool = True         # schedule loss weights (P2 / EDM)
 
 
-def make_train_step(
-    apply_fn: Callable[[PyTree, jax.Array, jax.Array, Any], jax.Array],
-    schedule: NoiseSchedule,
-    transform: PredictionTransform,
-    config: TrainStepConfig = TrainStepConfig(),
-    policy: Optional[Policy] = None,
-    autoencoder: Optional[Any] = None,
-    null_cond: Optional[PyTree] = None,
-) -> Callable[[TrainState, PyTree], Tuple[TrainState, jax.Array]]:
-    """Build the pure train step.
+def _make_loss_builder(apply_fn, schedule, transform, config,
+                       policy, autoencoder, null_cond):
+    """`(state, batch) -> loss_fn` shared by the train step and the
+    numerics probe: the same forward-diffusion prep and RNG derivation,
+    so a provenance re-run reproduces EXACTLY the step that produced
+    the non-finite values (same noise, same timesteps, same dropout)."""
 
-    apply_fn(params, x_t, t, cond) -> raw network output.
-    Batch contract: {"sample": [B,...] images (uint8 or [-1,1] float),
-    "cond": optional conditioning pytree (e.g. {"text": [B,L,D]})}.
-    `null_cond` is the cached unconditional embedding tree used for the
-    jnp.where CFG-dropout splice (the reference's correct semantics,
-    inputs/__init__.py:122-137 — not the prefix-splice variant).
-    """
-
-    def train_step(state: TrainState, batch: PyTree) -> Tuple[TrainState, jax.Array]:
+    def build(state: TrainState, batch: PyTree):
         rng = jax.random.fold_in(state.rng, state.step)
         noise_key, t_key, uncond_key, vae_key = jax.random.split(rng, 4)
 
@@ -115,6 +104,46 @@ def make_train_step(
                 axis=tuple(range(1, pred.ndim)))
             return jnp.mean(per_sample * weights)
 
+        return loss_fn
+
+    return build
+
+
+def make_train_step(
+    apply_fn: Callable[[PyTree, jax.Array, jax.Array, Any], jax.Array],
+    schedule: NoiseSchedule,
+    transform: PredictionTransform,
+    config: TrainStepConfig = TrainStepConfig(),
+    policy: Optional[Policy] = None,
+    autoencoder: Optional[Any] = None,
+    null_cond: Optional[PyTree] = None,
+    numerics: Optional[NumericsConfig] = None,
+) -> Callable[[TrainState, PyTree], Tuple[TrainState, jax.Array]]:
+    """Build the pure train step.
+
+    apply_fn(params, x_t, t, cond) -> raw network output.
+    Batch contract: {"sample": [B,...] images (uint8 or [-1,1] float),
+    "cond": optional conditioning pytree (e.g. {"text": [B,L,D]})}.
+    `null_cond` is the cached unconditional embedding tree used for the
+    jnp.where CFG-dropout splice (the reference's correct semantics,
+    inputs/__init__.py:122-137 — not the prefix-splice variant).
+
+    With `numerics`, the step additionally computes the in-graph
+    health aux (telemetry/numerics.py) and returns
+    `(new_state, loss, aux)`; with `numerics.skip_nonfinite` a step
+    whose gradients or loss are non-finite keeps the PREVIOUS
+    params/opt-state/EMA via `jnp.where` — the same gating the fp16
+    DynamicScale overflow path uses, so a poisoned batch never
+    contaminates state. The trainer compiles this as a SECOND program
+    and dispatches it only at the numerics cadence; off-cadence steps
+    run the unmonitored program unchanged.
+    """
+    build_loss = _make_loss_builder(apply_fn, schedule, transform, config,
+                                    policy, autoencoder, null_cond)
+
+    def train_step(state: TrainState, batch: PyTree):
+        loss_fn = build_loss(state, batch)
+
         if state.dynamic_scale is not None:
             grad_fn = state.dynamic_scale.value_and_grad(loss_fn)
             dyn, is_fin, loss, grads = grad_fn(state.params)
@@ -135,6 +164,59 @@ def make_train_step(
             new_state = state.apply_gradients(grads)
 
         new_state = new_state.apply_ema(config.ema_decay)
-        return new_state, loss
+        if numerics is None:
+            return new_state, loss
+
+        if numerics.skip_nonfinite:
+            # in-graph skip_step: keep the previous params/opt/EMA when
+            # this step's grads or loss are non-finite (the step counter
+            # still advances, so the next step folds a fresh rng). The
+            # aux is computed AFTER gating: grad_norm stays non-finite
+            # (it is the evidence) but update_norm reads 0 — the state
+            # really did not move.
+            from ..telemetry.numerics import tree_nonfinite_count
+            ok = jnp.logical_and(tree_nonfinite_count(grads) == 0,
+                                 jnp.isfinite(loss))
+
+            def gate(n, o):
+                return jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(ok, a, b), n, o)
+
+            new_state = new_state.replace(
+                params=gate(new_state.params, state.params),
+                opt_state=gate(new_state.opt_state, state.opt_state),
+                ema_params=(gate(new_state.ema_params, state.ema_params)
+                            if state.ema_params is not None else None))
+        aux = numerics_aux(loss, grads, state.params, new_state.params,
+                           per_module=numerics.per_module)
+        if numerics.skip_nonfinite:
+            aux["skipped"] = (~ok).astype(jnp.float32)
+        return new_state, loss, aux
 
     return train_step
+
+
+def make_grad_probe(
+    apply_fn: Callable[[PyTree, jax.Array, jax.Array, Any], jax.Array],
+    schedule: NoiseSchedule,
+    transform: PredictionTransform,
+    config: TrainStepConfig = TrainStepConfig(),
+    policy: Optional[Policy] = None,
+    autoencoder: Optional[Any] = None,
+    null_cond: Optional[PyTree] = None,
+) -> Callable[[TrainState, PyTree], PyTree]:
+    """NaN-provenance pass: `(state, batch) -> probe_aux pytree` of
+    per-top-level-module non-finite counts for grads AND params, plus
+    the loss. Shares `_make_loss_builder` with the train step, so the
+    probe replays the exact rng/noise/timesteps of the offending step —
+    it updates NOTHING (no optimizer, no EMA) and must be jitted
+    WITHOUT donation so the live state survives the re-run."""
+    build_loss = _make_loss_builder(apply_fn, schedule, transform, config,
+                                    policy, autoencoder, null_cond)
+
+    def probe(state: TrainState, batch: PyTree) -> PyTree:
+        loss_fn = build_loss(state, batch)
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return probe_aux(loss, grads, state.params)
+
+    return probe

@@ -80,6 +80,23 @@ class TrainerConfig:
     # without one it is the ordinary fallback restore. A missing
     # checkpoint is a cold start, not an error.
     restore_at_start: bool = False
+    # Training-health monitor (telemetry/numerics.py): every N steps the
+    # trainer dispatches a SECOND compiled step that also computes
+    # per-module grad/param norms, update ratios and non-finite counts
+    # in-graph; 0 disables and off-cadence steps run the unmonitored
+    # program unchanged (zero extra device work). Cadence steps pay one
+    # aux readback (a host sync) plus the host-side detector.
+    numerics_cadence: int = 0
+    # What a detected anomaly does: "warn" records events/metrics only;
+    # "skip_step" compiles the monitored step with an in-graph
+    # non-finite gate (a poisoned step's update never lands — z-score
+    # spikes still only warn, the state is donated by the time the host
+    # sees them); "rollback" restores the best state (or walks back to
+    # the newest restorable checkpoint when no best state exists yet —
+    # the PR-1/2 fallback-restore path) on any hard anomaly.
+    anomaly_action: str = "warn"
+    anomaly_zscore: float = 6.0
+    anomaly_window: int = 50
 
 
 class DiffusionTrainer:
@@ -133,15 +150,45 @@ class DiffusionTrainer:
                 from .optim import flatten_params
                 return flatten_params(inner_init(key), 1024)
 
+        from ..telemetry.numerics import ANOMALY_ACTIONS
+        if config.anomaly_action not in ANOMALY_ACTIONS:
+            raise ValueError(f"anomaly_action {config.anomaly_action!r} "
+                             f"not in {ANOMALY_ACTIONS}")
+
         step_cfg = TrainStepConfig(
             uncond_prob=config.uncond_prob,
             ema_decay=config.ema_decay,
             normalize=config.normalize,
             weighted_loss=config.weighted_loss,
         )
+        # kept for the lazily-jitted NaN-provenance probe (the rebound
+        # flat-params apply_fn, not the caller's original)
+        self._probe_inputs = (apply_fn, schedule, transform,
+                              dict(config=step_cfg, policy=policy,
+                                   autoencoder=autoencoder,
+                                   null_cond=null_cond))
         step_fn = make_train_step(apply_fn, schedule, transform, step_cfg,
                                   policy=policy, autoencoder=autoencoder,
                                   null_cond=null_cond)
+        monitored_step_fn = None
+        if config.numerics_cadence > 0:
+            from ..telemetry.numerics import NumericsConfig
+            monitored_step_fn = make_train_step(
+                apply_fn, schedule, transform, step_cfg,
+                policy=policy, autoencoder=autoencoder,
+                null_cond=null_cond,
+                numerics=NumericsConfig(
+                    # a flat-param state has no module structure
+                    per_module=not config.flat_params,
+                    # both recovery actions gate in-graph: under
+                    # `rollback` the restore replaces the step anyway,
+                    # and an unapplied poisoned update keeps the
+                    # provenance pass exact (an applied one smears NaNs
+                    # into EVERY module's params before the host can
+                    # react). Only `warn` leaves updates untouched —
+                    # its contract is strictly observational.
+                    skip_nonfinite=(config.anomaly_action
+                                    in ("skip_step", "rollback"))))
 
         # fp16 compute needs loss scaling (reference diffusion_trainer.py
         # :214-240 DynamicScale path); bf16's exponent range does not.
@@ -173,6 +220,19 @@ class DiffusionTrainer:
             donate_argnums=(0,),
             out_shardings=(self.state_shardings, NamedSharding(mesh, P())),
         )
+        # the monitored twin: same program + in-graph numerics aux
+        # (replicated scalars). Compiled separately so off-cadence steps
+        # keep running the EXACT unmonitored program.
+        self._step_monitored = None
+        if monitored_step_fn is not None:
+            self._step_monitored = jax.jit(
+                monitored_step_fn,
+                donate_argnums=(0,),
+                out_shardings=(self.state_shardings,
+                               NamedSharding(mesh, P()),
+                               NamedSharding(mesh, P())),
+            )
+        self._probe = None      # lazily-jitted NaN-provenance pass
 
         self.best_loss = float("inf")
         self.best_state: Optional[TrainState] = None
@@ -320,6 +380,78 @@ class DiffusionTrainer:
                                           self._numeric_subtree(batch))
         return loss
 
+    def train_step_monitored(self, batch: PyTree):
+        """The numerics-cadence step: returns (loss, aux) where `aux` is
+        the in-graph health pytree (telemetry/numerics.py). Requires
+        `numerics_cadence > 0` at construction."""
+        from ..parallel.context import use_mesh
+        with use_mesh(self.mesh):
+            self.state, loss, aux = self._step_monitored(
+                self.state, self._numeric_subtree(batch))
+        return loss, aux
+
+    # -- training-health internals -------------------------------------------
+    def _poison_module_params(self) -> str:
+        """`numerics.nan` chaos site: corrupt the params of ONE
+        deterministic module (first in sorted key order, at the same
+        module level the numerics breakdown reports) with NaNs — the
+        planted non-finite gradient the provenance pass must localize.
+        Flat-param states have no modules; the whole vector is poisoned
+        (provenance then degrades to the global count)."""
+        from ..telemetry.numerics import unwrap_module_tree
+        params = self.state.params
+
+        def nan_like(tree):
+            return jax.tree_util.tree_map(
+                lambda x: x * jnp.float32(jnp.nan).astype(x.dtype), tree)
+
+        inner, path = unwrap_module_tree(params)
+        if isinstance(inner, dict) and inner:
+            name = sorted(inner)[0]
+            poisoned = dict(inner)
+            poisoned[name] = nan_like(inner[name])
+            for key in reversed(path):      # re-wrap the envelope
+                poisoned = {key: poisoned}
+        else:
+            name, poisoned = "<flat>", nan_like(params)
+        self.state = self.state.replace(params=poisoned)
+        return name
+
+    def _nan_provenance(self, batch: PyTree, tel, step: int):
+        """On first non-finite detection: re-run ONE gradient pass (no
+        update, no donation — the live state survives) and name the
+        top-level module(s) whose grads or params hold non-finite
+        values. The probe shares the step's loss builder, so it replays
+        the exact rng/noise/timesteps of the offending step."""
+        from ..telemetry.numerics import nonfinite_modules
+        if self._probe is None:
+            from .train_step import make_grad_probe
+            apply_fn, schedule, transform, kw = self._probe_inputs
+            self._probe = jax.jit(make_grad_probe(
+                apply_fn, schedule, transform, **kw))
+        from ..parallel.context import use_mesh
+        # the live state's step counter already advanced past the
+        # offending step; rewind it for the probe so the rng fold —
+        # and with it noise/timesteps/dropout — replays exactly
+        probe_state = self.state.replace(
+            step=jnp.maximum(self.state.step - 1, 0))
+        with tel.span("numerics.provenance", cat="numerics",
+                      args={"step": step}):
+            with use_mesh(self.mesh):
+                probe = self._probe(probe_state,
+                                    self._numeric_subtree(batch))
+            modules = nonfinite_modules(probe)
+        detail = (f"non-finite values localized to module(s) "
+                  f"{modules}" if modules else
+                  "no per-module non-finite values found (non-finite "
+                  "loss without non-finite grads/params — bad batch?)")
+        _res_events.global_event_log().record(
+            "nan_provenance", "numerics.provenance",
+            detail=detail, step=step)
+        tel.write_record({"type": "nan_provenance", "step": int(step),
+                          "modules": modules})
+        return modules
+
     def fit(self,
             data: Iterator[PyTree],
             total_steps: int,
@@ -362,6 +494,23 @@ class DiffusionTrainer:
         # per-fit goodput delta: the hub may be process-global/cumulative
         gp_base_prod, gp_base_bad = goodput.raw_counters()
 
+        # Training-health: the detector owns BOTH the cadence anomaly
+        # checks and the historical abnormal-loss trigger (non-finite /
+        # <= floor), so fault-injected and real NaNs take one code path.
+        from ..telemetry.memory import MemoryMonitor
+        from ..telemetry.numerics import AnomalyConfig, AnomalyDetector
+        detector = AnomalyDetector(
+            AnomalyConfig(zscore=cfg.anomaly_zscore,
+                          window=cfg.anomaly_window,
+                          abnormal_loss_floor=cfg.abnormal_loss_floor,
+                          action=cfg.anomaly_action),
+            telemetry=tel)
+        memory = MemoryMonitor()
+        history["anomalies"] = 0
+        last_health = {"grad_norm": None}   # latest cadence grad norm
+        provenance_done = False     # the debug re-run happens ONCE per fit
+        monitored_compiled = False  # first cadence step pays a 2nd compile
+
         # Resume-at-start: under coordination this is the consensus
         # round — it must run BEFORE any step so a divergent world
         # raises here, never trains. ConsensusError propagates.
@@ -401,6 +550,39 @@ class DiffusionTrainer:
                 history["coordination_lost"] = True
                 if not final:
                     stop["flag"] = True
+
+        def handle_numerics(step_no: int, aux, step_batch) -> None:
+            """Cadence-step health handling: flatten the aux (the host
+            readback), export gauges + the `numerics` JSONL row + HBM
+            gauges, run the detector, and on the first HARD (non-finite)
+            anomaly run the provenance pass and the configured action.
+            Soft z-score anomalies always only warn under `skip_step`
+            (state is already donated); under `rollback` only hard
+            anomalies roll back — a 6-sigma loss spike is evidence, a
+            NaN is proof."""
+            nonlocal provenance_done
+            from ..telemetry.numerics import flatten_aux
+            flat = flatten_aux(aux)
+            last_health["grad_norm"] = flat.get("numerics/grad_norm")
+            tel.record_numerics(flat, step=step_no)
+            memory.record(tel.registry)
+            if flat.get("numerics/skipped", 0.0) > 0:
+                tel.counter("numerics/skipped_steps").inc()
+                events.record("skip_step", "numerics.skip",
+                              detail="non-finite grads/loss; update "
+                                     "gated in-graph (state unchanged)",
+                              step=step_no)
+            anomalies = detector.observe_aux(step_no, flat)
+            if not anomalies:
+                return
+            history["anomalies"] += len(anomalies)
+            hard = [a for a in anomalies if a.hard]
+            if hard and not provenance_done:
+                provenance_done = True
+                self._nan_provenance(step_batch, tel, step_no)
+            if hard and cfg.anomaly_action == "rollback":
+                self._recover(flat.get("numerics/loss", float("nan")),
+                              step=step_no)
 
         # SIGTERM -> finish the current step, checkpoint, return. Only
         # the main thread may install handlers; elsewhere (e.g. fit
@@ -463,25 +645,31 @@ class DiffusionTrainer:
         # raising callback) must still restore the SIGTERM handler — a
         # leaked _on_term would swallow every later SIGTERM — and close
         # any open profiler trace.
-        def settle_step(idx: int) -> Dict[str, float]:
+        def settle_step(idx: int, compile_step: bool = False
+                        ) -> Dict[str, float]:
             """Close the step's phase window, emit the per-step row, and
             attribute its wall-clock to the goodput account: host +
-            device + residual of step 1 is `compile` badput (the jit
-            heuristic — a warm cache mislabels one cheap step), later
-            steps are productive; data waits are `data_stall`; the
-            checkpoint phase is `checkpoint_commit`, or
-            `coordination_lost` when this step's commit round timed out
-            discovering a dead peer."""
+            device + residual of step 1 — and of the FIRST
+            numerics-cadence step, which compiles the monitored twin —
+            is `compile` badput (the jit heuristic — a warm cache
+            mislabels one cheap step), later steps are productive; data
+            waits are `data_stall`; the checkpoint phase is
+            `checkpoint_commit`, or `coordination_lost` when this
+            step's commit round timed out discovering a dead peer; the
+            `numerics` phase (aux readback + detector + any provenance
+            re-run/rollback) is its own badput bucket — monitoring
+            overhead must not masquerade as training."""
             phases = timer.end_step()
             if timed:
                 tel.record_step(phases)
             busy = (phases.get("host", 0.0) + phases.get("device", 0.0)
                     + phases.get("other", 0.0))
-            if idx == 0:
+            if idx == 0 or compile_step:
                 goodput.record_badput("compile", busy)
             else:
                 goodput.record_productive(busy)
             goodput.record_badput("data_stall", phases.get("data_wait", 0.0))
+            goodput.record_badput("numerics", phases.get("numerics", 0.0))
             goodput.record_badput(
                 "coordination_lost" if history["coordination_lost"]
                 else "checkpoint_commit", phases.get("checkpoint", 0.0))
@@ -507,9 +695,14 @@ class DiffusionTrainer:
                 if fault_plan is not None:
                     # chaos sites (use error="flag" specs): a NaN poisons
                     # the next loss readback so the rollback path runs; a
-                    # sigterm exercises the preemption path end-to-end.
+                    # sigterm exercises the preemption path end-to-end; a
+                    # numerics.nan corrupts ONE module's params so the
+                    # numerics monitor + provenance pass must catch AND
+                    # localize it.
                     if fault_plan.check("step.nan", step=i + 1):
                         nan_pending = True
+                    if fault_plan.check("numerics.nan", step=i + 1):
+                        self._poison_module_params()
                     if fault_plan.check("host.sigterm", step=i + 1):
                         import os as _os
                         _os.kill(_os.getpid(), signal.SIGTERM)
@@ -524,13 +717,23 @@ class DiffusionTrainer:
                         profile_ctx.__exit__(None, None, None)
                         profile_ctx = None
                 current = global_batch
+                monitored = (self._step_monitored is not None
+                             and (i + 1) % cfg.numerics_cadence == 0)
+                compile_step = monitored and not monitored_compiled
                 timer.begin_step(i + 1)
-                if watchdog is not None and i == 0:
-                    # first call pays jit compile — not a stall
+                if watchdog is not None and (i == 0 or compile_step):
+                    # first call of either program pays jit compile —
+                    # not a stall
                     watchdog.pause()
+                pending_aux = None
                 with timer.phase("host"):
-                    pending_loss = self.train_step(current)
-                if watchdog is not None and i == 0:
+                    if monitored:
+                        pending_loss, pending_aux = \
+                            self.train_step_monitored(current)
+                        monitored_compiled = True
+                    else:
+                        pending_loss = self.train_step(current)
+                if watchdog is not None and (i == 0 or compile_step):
                     watchdog.resume()
                 if i + 1 < total_steps:
                     with timer.phase("data_wait"):
@@ -542,6 +745,12 @@ class DiffusionTrainer:
                     # block first (the async-dispatch lie)
                     with timer.phase("device"):
                         jax.block_until_ready(pending_loss)
+                if pending_aux is not None:
+                    # the one host sync a cadence step pays: aux
+                    # readback, gauges + JSONL row, detector verdicts,
+                    # and (first hard anomaly only) provenance + action
+                    with timer.phase("numerics"):
+                        handle_numerics(i + 1, pending_aux, current)
                 steps_in_window += 1
 
                 recovered = False
@@ -549,7 +758,10 @@ class DiffusionTrainer:
                     loss = float(pending_loss)
                     if nan_pending:
                         loss, nan_pending = float("nan"), False
-                    if not np.isfinite(loss) or loss <= cfg.abnormal_loss_floor:
+                    # ONE code path for fault-injected and real NaNs:
+                    # the detector's hard triggers subsume the old
+                    # `isfinite or <= floor` ad-hoc check
+                    if detector.abnormal_loss(loss, step=i + 1) is not None:
                         self._recover(loss, step=i + 1)
                         steps_in_window = 0
                         log_t0 = time.perf_counter()
@@ -594,6 +806,10 @@ class DiffusionTrainer:
                         if timed:
                             tel.gauge("train/loss").set(loss)
                             tel.gauge("train/imgs_per_sec").set(ips)
+                            # HBM gauges ride the log cadence even when
+                            # the numerics monitor is off (host-only
+                            # allocator read; self-disables off-TPU)
+                            memory.record(tel.registry)
                             # pod-wide skew: every host contributes its
                             # window means; rank 0 logs min/max/p50/p99.
                             # A collective — all hosts hit log cadence
@@ -601,6 +817,10 @@ class DiffusionTrainer:
                             # as the commit rounds).
                             agg = {"step_time": dt / max(window_steps, 1),
                                    "imgs_per_sec": ips, "loss": loss}
+                            if last_health["grad_norm"] is not None:
+                                # pod/grad_norm/spread: divergence skew —
+                                # one host drifting shows before it NaNs
+                                agg["grad_norm"] = last_health["grad_norm"]
                             if timer.last is not None:
                                 agg["data_wait"] = timer.last.get(
                                     "data_wait", 0.0)
@@ -619,8 +839,8 @@ class DiffusionTrainer:
                         loss_now = float(pending_loss)
                         if nan_pending:
                             loss_now, nan_pending = float("nan"), False
-                        if (not np.isfinite(loss_now)
-                                or loss_now <= cfg.abnormal_loss_floor):
+                        if detector.abnormal_loss(loss_now,
+                                                  step=i + 1) is not None:
                             self._recover(loss_now, step=i + 1)
                         else:
                             with tel.span("ckpt.save_and_commit",
@@ -630,7 +850,7 @@ class DiffusionTrainer:
                                 count_save()
                                 commit_save()
                             goodput.persist()
-                settle_step(i)
+                settle_step(i, compile_step=compile_step)
 
             # The final save can legitimately outlast the watchdog timeout
             # (sync flush of an async save) — stand the watchdog down
@@ -682,25 +902,43 @@ class DiffusionTrainer:
         return history
 
     def _recover(self, bad_loss: float, step: Optional[int] = None):
-        """Abnormal-loss recovery (reference simple_trainer.py:542-575):
-        scan params, clear compilation caches are unnecessary here (state
-        is functional) — restore the best state if we have one."""
-        rolled_back = self.best_state is not None
-        _res_events.global_event_log().record(
-            "rollback", "train.step",
-            detail=f"abnormal loss {bad_loss}; "
-                   + ("restored best state"
-                      if rolled_back else "no best state — continuing "
-                      "with fresh rng fold"),
-            step=step)
-        if rolled_back:
-            tel = self.telemetry if self.telemetry is not None \
-                else _global_telemetry()
+        """Abnormal-loss / anomaly recovery (reference
+        simple_trainer.py:542-575): restore the best state if we have
+        one; with no best state yet but a checkpointer holding a
+        restorable step, walk back to it (the PR-1/2 fallback-restore
+        path — corrupt newer steps are skipped, ledger mode restores
+        only committed steps). Only with neither does the run continue
+        on a fresh rng fold."""
+        tel = self.telemetry if self.telemetry is not None \
+            else _global_telemetry()
+        if self.best_state is not None:
+            _res_events.global_event_log().record(
+                "rollback", "train.step",
+                detail=f"abnormal loss {bad_loss}; restored best state",
+                step=step)
             with tel.span("train.rollback", cat="restore",
                           args={"step": step, "loss": repr(bad_loss)}):
                 self.state = jax.tree_util.tree_map(jnp.copy,
                                                     self.best_state)
-        # else: keep going with fresh RNG fold — the step folds rng by step
+            return
+        if self.checkpointer is not None \
+                and self.checkpointer.latest_step() is not None:
+            with tel.span("train.rollback", cat="restore",
+                          args={"step": step, "loss": repr(bad_loss),
+                                "source": "checkpoint"}):
+                restored = self.restore_checkpoint()
+            _res_events.global_event_log().record(
+                "rollback", "train.step",
+                detail=f"abnormal loss {bad_loss}; no best state — "
+                       f"restored checkpoint step {restored}",
+                step=step)
+            return
+        _res_events.global_event_log().record(
+            "rollback", "train.step",
+            detail=f"abnormal loss {bad_loss}; no best state — "
+                   "continuing with fresh rng fold",
+            step=step)
+        # keep going with fresh RNG fold — the step folds rng by step
         # counter, so the next batch draws different noise.
 
     # -- inference-side helpers ---------------------------------------------

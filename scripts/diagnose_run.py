@@ -105,16 +105,77 @@ def pod_section(pods: List[Dict], lines: List[str]) -> None:
     world = int(last.get("world", 1))
     lines.append(f"== Pod skew (world of {world}, "
                  f"step {last.get('step', '?')}) ==")
-    metrics = sorted({k.split("/")[1] for k in last
-                      if k.startswith("pod/") and k.count("/") == 2})
-    lines.append(f"{'metric':<16s} {'min':>10s} {'p50':>10s} {'p99':>10s} "
+    # metric names may themselves be nested (pod/goodput/badput/..._s/max):
+    # the stat is always the LAST component, the metric everything between
+    metrics = sorted({k[len("pod/"):k.rfind("/")] for k in last
+                      if k.startswith("pod/") and k.count("/") >= 2})
+    lines.append(f"{'metric':<28s} {'min':>10s} {'p50':>10s} {'p99':>10s} "
                  f"{'max':>10s} {'spread':>8s}")
     for m in metrics:
         def g(stat, m=m):
             return float(last.get(f"pod/{m}/{stat}", float("nan")))
-        lines.append(f"{m:<16s} {g('min'):10.4f} {g('p50'):10.4f} "
+        lines.append(f"{m:<28s} {g('min'):10.4f} {g('p50'):10.4f} "
                      f"{g('p99'):10.4f} {g('max'):10.4f} "
                      f"{g('spread'):8.1%}")
+    lines.append("")
+
+
+def health_section(numerics: List[Dict], anomalies: List[Dict],
+                   provenance: List[Dict], metrics: List[Dict],
+                   lines: List[str]) -> None:
+    """Training-health report: the numerics stream, detected anomalies
+    (with the module the provenance pass blamed), and HBM gauges."""
+    last_snap = metrics[-1] if metrics else {}
+    have_mem = any(k.startswith("memory/") for k in last_snap)
+    if not numerics and not anomalies and not have_mem:
+        return
+    lines.append("== Training health ==")
+    if numerics:
+        last = numerics[-1]
+        nonfinite_rows = sum(
+            1 for r in numerics if float(r.get("numerics/grad_nonfinite",
+                                               0.0)) > 0)
+        lines.append(f"numerics rows:      {len(numerics)} "
+                     f"(last at step {last.get('step', '?')}; "
+                     f"{nonfinite_rows} with non-finite grads)")
+        for key in ("numerics/loss", "numerics/grad_norm",
+                    "numerics/param_norm", "numerics/update_ratio"):
+            if key in last:
+                lines.append(f"{key:<28s} {float(last[key]):>14.6g}")
+        mods = sorted({k.split("/")[2] for k in last
+                       if k.startswith("numerics/module/")})
+        if mods:
+            lines.append(f"{'module':<20s} {'grad_norm':>12s} "
+                         f"{'update_ratio':>14s} {'nonfinite':>10s}")
+            for m in mods:
+                def g(stat, m=m):
+                    return float(last.get(f"numerics/module/{m}/{stat}",
+                                          float("nan")))
+                lines.append(f"{m:<20s} {g('grad_norm'):>12.4g} "
+                             f"{g('update_ratio'):>14.4g} "
+                             f"{g('grad_nonfinite'):>10.0f}")
+    if anomalies:
+        lines.append(f"anomalies:          {len(anomalies)}")
+        for a in anomalies[-5:]:
+            lines.append(f"  step {a.get('step', '?'):>6} "
+                         f"{a.get('kind', '?'):<16s} "
+                         f"{a.get('metric', '')}={a.get('value')} "
+                         f"-> action {a.get('action', '?')}")
+    for p in provenance[-3:]:
+        mods = p.get("modules") or []
+        lines.append(f"nan provenance:     step {p.get('step', '?')} -> "
+                     + (", ".join(mods) if mods
+                        else "(no module localized)"))
+    if have_mem:
+        gib = 1024.0 ** 3
+        in_use = float(last_snap.get("memory/bytes_in_use", 0.0))
+        peak = float(last_snap.get("memory/peak_bytes_in_use", 0.0))
+        limit = float(last_snap.get("memory/bytes_limit", 0.0))
+        util = float(last_snap.get("memory/utilization", 0.0))
+        lines.append(f"hbm in use:         {in_use / gib:10.2f} GiB"
+                     + (f" of {limit / gib:.2f} GiB ({util:6.1%})"
+                        if limit else ""))
+        lines.append(f"hbm peak:           {peak / gib:10.2f} GiB")
     lines.append("")
 
 
@@ -125,7 +186,7 @@ def counters_section(metrics: List[Dict], lines: List[str]) -> None:
     interesting = {k: v for k, v in last.items()
                    if isinstance(v, (int, float))
                    and (k.startswith(("data/", "telemetry/", "resilience/",
-                                      "inference/"))
+                                      "inference/", "numerics/", "memory/"))
                         or k.startswith("goodput/"))}
     if not interesting:
         return
@@ -171,6 +232,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     steps = [r for r in records if r.get("type") == "step_phases"]
     pods = [r for r in records if r.get("type") == "pod_metrics"]
     metrics = [r for r in records if r.get("type") == "metrics"]
+    numerics = [r for r in records if r.get("type") == "numerics"]
+    anomalies = [r for r in records if r.get("type") == "numerics_anomaly"]
+    provenance = [r for r in records if r.get("type") == "nan_provenance"]
 
     goodput: Dict = {}
     gp_path = os.path.join(directory, "goodput.json")
@@ -192,13 +256,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         wall = sum(float(r.get("wall", 0.0)) for r in steps)
         doc = {"goodput": goodput, "steps": len(steps),
                "step_wall_s": wall,
-               "pod_last": (pods[-1] if pods else None)}
+               "pod_last": (pods[-1] if pods else None),
+               "health": {"numerics_rows": len(numerics),
+                          "numerics_last": (numerics[-1] if numerics
+                                            else None),
+                          "anomalies": anomalies,
+                          "nan_provenance": provenance}}
         print(json.dumps(doc, indent=2))
         return 0
 
     lines: List[str] = [f"telemetry report: {jsonl}", ""]
     goodput_section(goodput, lines)
     phase_section(steps, lines)
+    health_section(numerics, anomalies, provenance, metrics, lines)
     pod_section(pods, lines)
     counters_section(metrics, lines)
     trace_path = os.path.join(directory, "trace.json")

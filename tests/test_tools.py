@@ -189,3 +189,47 @@ def test_bare_except_gate_accepts_handlers_that_act(tmp_path):
         "        raise RuntimeError('context')\n")
     from scripts.check_bare_except import main
     assert main(["--root", str(ok)]) == 0
+
+
+# -- metric-name gate (scripts/check_metric_names.py) -------------------------
+
+def test_repo_metric_names_all_documented():
+    """Tier-1 gate: every metric name emitted in flaxdiff_tpu/ appears
+    in the docs/OBSERVABILITY.md reference table — an undocumented
+    series is half-observability."""
+    from scripts.check_metric_names import main
+    assert main([]) == 0
+
+
+def test_metric_gate_flags_undocumented_name(tmp_path, capsys):
+    code = tmp_path / "emitter.py"
+    code.write_text(
+        "def f(reg):\n"
+        "    reg.counter('secret/undocumented').inc()\n"
+        "    reg.gauge('train/loss').set(1.0)\n")
+    docs = tmp_path / "docs.md"
+    docs.write_text("| `train/loss` | gauge | documented |\n")
+    from scripts.check_metric_names import main
+    assert main(["--root", str(code), "--docs", str(docs)]) == 1
+    err = capsys.readouterr().err
+    assert "secret/undocumented" in err and "train/loss" not in err
+
+
+def test_metric_gate_wildcards_cover_fstrings_and_placeholders(tmp_path):
+    """f-string emissions match docs entries with <placeholder>
+    segments; exact names match either way; variable-name emissions
+    are invisible (documented by hand)."""
+    code = tmp_path / "emitter.py"
+    code.write_text(
+        "def f(reg, name):\n"
+        "    reg.histogram(f'phase/{name}').observe(0.1)\n"
+        "    reg.gauge('numerics/module/Conv_0/grad_norm').set(1.0)\n"
+        "    reg.gauge(name).set(1.0)\n")       # variable: ungated
+    docs = tmp_path / "docs.md"
+    docs.write_text("- `phase/<name>` histograms\n"
+                    "- `numerics/module/<module>/<stat>` rows\n")
+    from scripts.check_metric_names import main
+    assert main(["--root", str(code), "--docs", str(docs)]) == 0
+    # remove the wildcard: the f-string prefix is now undocumented
+    docs.write_text("- `numerics/module/<module>/<stat>` rows\n")
+    assert main(["--root", str(code), "--docs", str(docs)]) == 1

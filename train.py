@@ -121,6 +121,19 @@ def parse_args(argv=None):
                         "in Prometheus text format (atomic rename; "
                         "node-exporter textfile-collector convention). "
                         "Requires --telemetry_dir")
+    p.add_argument("--numerics_cadence", type=int, default=0,
+                   help="every N steps run the training-health monitor "
+                        "inside the jitted step (per-module grad/param "
+                        "norms, update ratios, non-finite counts; "
+                        "docs/OBSERVABILITY.md). Off-cadence steps run "
+                        "the unmonitored program unchanged; 0 disables")
+    p.add_argument("--anomaly_action", default="warn",
+                   choices=["warn", "skip_step", "rollback"],
+                   help="what a detected numerics anomaly does: warn "
+                        "(events/metrics only), skip_step (non-finite "
+                        "updates gated in-graph, never applied), or "
+                        "rollback (restore best state / newest "
+                        "restorable checkpoint on hard anomalies)")
     p.add_argument("--watchdog_timeout", type=float, default=None,
                    help="seconds without a completed step before the "
                         "train-loop watchdog checkpoints and exits "
@@ -498,7 +511,9 @@ def main(argv=None):
                              log_every=args.log_every, seed=args.seed,
                              profile_dir=args.profile_dir,
                              flat_params=args.flat_params,
-                             watchdog_timeout=args.watchdog_timeout),
+                             watchdog_timeout=args.watchdog_timeout,
+                             numerics_cadence=args.numerics_cadence,
+                             anomaly_action=args.anomaly_action),
         policy=policy, null_cond=null_cond, checkpointer=ckpt,
         autoencoder=autoencoder, telemetry=telemetry)
 
