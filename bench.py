@@ -174,7 +174,12 @@ def build_trainer(tpu_native: bool, image_size: int = IMAGE_SIZE,
         transform=EpsilonPredictionTransform(),
         mesh=mesh,
         config=TrainerConfig(uncond_prob=0.12, normalize=False,
-                             flat_params=flat_params),
+                             flat_params=flat_params,
+                             # the reference-semantics baseline has no
+                             # in-graph non-finite gate (its NaN check
+                             # is the per-step host sync run() applies);
+                             # ours ships the production default
+                             gate_nonfinite=tpu_native),
         null_cond=null_cond,
     )
 
@@ -1031,6 +1036,126 @@ def stage_ablate(args) -> dict:
     return res
 
 
+def stage_dispatch(args) -> dict:
+    """Step-loop overhead: the r5 sync-free pipelined fit() measured at
+    pipeline_depth 1/2/4 with telemetry off / on(sample_every=1) /
+    on(sample_every=8).
+
+    Uses a deliberately TINY model so the number is dominated by loop
+    mechanics (dispatch, loss-window bookkeeping, phase timing, the
+    telemetry sync policy), not model compute — the regime where
+    BENCH_r05's per-step host sync cost its 0.892x vs the reference
+    binary. The acceptance bar: telemetry-on (sampled) step time within
+    2% of telemetry-off at depth 2. Each cell times fit() itself (the
+    production loop), after a warm fit so compile stays out of the
+    window. log_every is 50 — the production cadence floor — so the
+    per-window work (loss fetch, export, goodput persist, pod gather)
+    carries a REPRESENTATIVE amortized share: on a ~2 ms toy step,
+    log_every=10 would charge window work 5-10x the share it has on
+    any real run (where steps are 50-1000x longer and cadences 50+),
+    and the cell would measure logging configuration, not the loop."""
+    _apply_jax_platforms()
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import flax.linen as nn
+    from flaxdiff_tpu import telemetry as T
+    from flaxdiff_tpu.parallel import create_mesh
+    from flaxdiff_tpu.predictors import EpsilonPredictionTransform
+    from flaxdiff_tpu.schedulers import CosineNoiseSchedule
+    from flaxdiff_tpu.trainer import DiffusionTrainer, TrainerConfig
+
+    cpu = jax.devices()[0].platform == "cpu"
+    steps = 150 if (cpu or args.quick) else 300
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, t, cond=None):
+            h = nn.Conv(16, (3, 3))(x)
+            return nn.Conv(x.shape[-1], (3, 3))(jnp.tanh(h))
+
+    model = Tiny()
+
+    def apply_fn(params, x, t, cond):
+        return model.apply({"params": params}, x, t, None)
+
+    def init_fn(key):
+        return model.init(key, jnp.zeros((1, 16, 16, 1)),
+                          jnp.zeros((1,)))["params"]
+
+    mesh = create_mesh(axes={"data": -1})
+    rng = np.random.default_rng(0)
+    batches = [{"sample": rng.normal(size=(8, 16, 16, 1))
+                .astype(np.float32)} for _ in range(4)]
+
+    def data():
+        i = 0
+        while True:
+            yield batches[i % len(batches)]
+            i += 1
+
+    def timed_fit(depth: int, sample_every: int, telemetry_on: bool,
+                  repeats: int = 3):
+        """Median step time over `repeats` timed fits (one stall — GC,
+        another process on a shared CPU box — must not become the
+        recorded cell)."""
+        trainer = DiffusionTrainer(
+            apply_fn=apply_fn, init_fn=init_fn, tx=optax.adam(1e-3),
+            schedule=CosineNoiseSchedule(timesteps=100),
+            transform=EpsilonPredictionTransform(), mesh=mesh,
+            config=TrainerConfig(normalize=False, log_every=50,
+                                 pipeline_depth=depth,
+                                 telemetry_sample_every=sample_every))
+        trainer.fit(data(), total_steps=5)      # compile out of band
+        tmp = None
+        if telemetry_on:
+            tmp = tempfile.mkdtemp(prefix="bench_dispatch_tel_")
+            trainer.telemetry = T.Telemetry.create(tmp)
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            trainer.fit(data(), total_steps=steps)
+            times.append(time.perf_counter() - t0)
+        if trainer.telemetry is not None:
+            trainer.telemetry.close()
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+        del trainer
+        return sorted(times)[len(times) // 2] / steps
+
+    res = {"platform": jax.devices()[0].platform, "steps": steps,
+           "configs": {}}
+    for depth in (1, 2, 4):
+        for key, kwargs in (
+                ("tel_off", dict(sample_every=1, telemetry_on=False)),
+                ("tel_on_s1", dict(sample_every=1, telemetry_on=True)),
+                ("tel_on_s8", dict(sample_every=8, telemetry_on=True))):
+            name = f"depth{depth}/{key}"
+            try:
+                st = timed_fit(depth, **kwargs)
+                res["configs"][name] = {"step_time_ms": round(st * 1e3, 3)}
+                log(f"dispatch {name}: {st * 1e3:.3f} ms/step")
+            except Exception:
+                res["configs"][name] = {
+                    "error": traceback.format_exc()[-400:]}
+                log(f"dispatch {name}: FAILED")
+        print(json.dumps(res), flush=True)   # salvage point per depth
+    off = res["configs"].get("depth2/tel_off", {}).get("step_time_ms")
+    s8 = res["configs"].get("depth2/tel_on_s8", {}).get("step_time_ms")
+    s1 = res["configs"].get("depth2/tel_on_s1", {}).get("step_time_ms")
+    if off and s8:
+        # the acceptance ratio: sampled telemetry must be ~free
+        res["telemetry_sampled_overhead_depth2"] = round(s8 / off - 1, 4)
+    if off and s1:
+        res["telemetry_exact_overhead_depth2"] = round(s1 / off - 1, 4)
+    return res
+
+
 def stage_longseq(args) -> dict:
     """Long-context attention on hardware: flash fwd+bwd at 8k/16k/32k
     tokens, XLA attempted at the same shapes for contrast.
@@ -1108,13 +1233,16 @@ STAGES = {"flashtune": stage_flashtune, "sweep": stage_sweep,
           "sweep256": stage_sweep256, "ref": stage_ref,
           "refreal": stage_refreal,
           "ddim": stage_ddim, "attnpad": stage_attnpad,
-          "ablate": stage_ablate, "longseq": stage_longseq}
+          "ablate": stage_ablate, "longseq": stage_longseq,
+          "dispatch": stage_dispatch}
 
 # info-value order (VERDICT r3 next #1): the headline sweep first, its
-# baseline second; flashtune is cheap and unblocks the tuned micros;
-# ddim is the BASELINE.md inference target; the rest are diagnostics.
-STAGE_ORDER = ("sweep", "ref", "refreal", "flashtune", "ddim",
-               "attnpad", "ablate", "sweep256", "longseq")
+# baseline second; refreal anchors vs_reference_binary; dispatch is the
+# r5 step-loop-overhead evidence (cheap — tiny model); flashtune is
+# cheap and unblocks the tuned micros; ddim is the BASELINE.md
+# inference target; the rest are diagnostics.
+STAGE_ORDER = ("sweep", "ref", "refreal", "dispatch", "flashtune",
+               "ddim", "attnpad", "ablate", "sweep256", "longseq")
 
 # rough healthy-tunnel cost estimates (seconds) for budget scheduling —
 # a stage is skipped when the remaining budget can't cover its MINIMUM
@@ -1126,7 +1254,10 @@ STAGE_ORDER = ("sweep", "ref", "refreal", "flashtune", "ddim",
 # (4 shapes x 2 impls, each a fresh compile)
 STAGE_EST = {"sweep": 900, "ref": 450, "refreal": 700, "flashtune": 500,
              "ddim": 600, "attnpad": 90, "ablate": 1100, "sweep256": 800,
-             "longseq": 550}   # + r5 on-chip 16k correctness cell
+             "longseq": 550,   # + r5 on-chip 16k correctness cell
+             # 9 tiny-model fit cells (3 depths x 3 telemetry modes),
+             # each ~steps x a-few-ms + one tiny-model compile
+             "dispatch": 240}
 
 # stages that receive the flashtune winner env. Headline stages
 # (sweep/ref/ddim/sweep256) run with code defaults: an unvalidated

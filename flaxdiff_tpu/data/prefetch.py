@@ -81,3 +81,98 @@ def prefetch_map(fn: Callable[[T], U], it: Iterator[T],
             yield got
     finally:
         stop.set()
+
+
+class prefetch_to_device:
+    """H2D upload prefetch: apply `put_fn` (host numpy batch -> sharded
+    device arrays, e.g. `DiffusionTrainer.put_batch`) in a background
+    thread, keeping up to `depth` uploaded batches ready — the host-to-
+    device copy overlaps device compute instead of serializing with it,
+    even on steps where the consumer closes dispatch (telemetry-sampled
+    steps). Order-preserving; exceptions re-raise at the consumer's
+    `next()` like `prefetch_map`.
+
+    Unlike the bare generator, this wrapper exposes `close()` with a
+    bounded worker join: the fit loop shares its source iterator with
+    other consumers (validation pulls real batches between fit chunks),
+    so on exit the worker must actually STOP before anyone else touches
+    the iterator — two threads driving one generator is a race, not
+    just a lost batch. Up to `depth + 1` prefetched batches are
+    discarded on close (an accepted cost on streaming data; documented
+    in `DiffusionTrainer.fit`). A worker wedged inside the source
+    iterator past `join_timeout` is abandoned (daemon) with a
+    `pipeline_error`-adjacent warning event rather than hanging the
+    caller's shutdown."""
+
+    def __init__(self, put_fn: Callable[[T], U], it: Iterator[T],
+                 depth: int = 2, join_timeout: float = 5.0):
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._join_timeout = join_timeout
+        self._done = False
+
+        def put(item) -> bool:
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for item in it:
+                    if self._stop.is_set():
+                        return
+                    if not put(put_fn(item)):
+                        return
+            except BaseException as e:
+                from ..resilience.events import record_event
+                record_event("pipeline_error", "data.put_batch",
+                             detail=f"{type(e).__name__}: {e}")
+                put((_SENTINEL, e))
+                return
+            put((_SENTINEL, None))
+
+        self._thread = threading.Thread(target=worker, daemon=True,
+                                        name="flaxdiff-put-batch")
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        got = self._q.get()
+        if isinstance(got, tuple) and len(got) == 2 \
+                and got[0] is _SENTINEL:
+            self._done = True
+            if got[1] is not None:
+                raise got[1]
+            raise StopIteration
+        return got
+
+    def close(self) -> None:
+        """Stop the worker and join it (bounded). Prefetched-but-unread
+        batches are discarded; the source iterator is safe to hand to
+        another consumer once this returns with the worker dead."""
+        self._stop.set()
+        # drain so a worker blocked on a full queue sees the stop flag
+        # at its next put poll instead of racing the join below
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(self._join_timeout)
+        if self._thread.is_alive():
+            from ..resilience.events import record_event
+            record_event("warning", "data.put_batch",
+                         detail="upload-prefetch worker did not stop "
+                                f"within {self._join_timeout}s (source "
+                                "iterator wedged?); it may consume one "
+                                "more item before dying")

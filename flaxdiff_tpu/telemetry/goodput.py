@@ -86,6 +86,27 @@ class GoodputLedger:
         with self._lock:
             self._badput[bucket] = self._badput.get(bucket, 0.0) + s
 
+    def reattribute(self, bucket: str, seconds: float) -> float:
+        """Move up to `seconds` from a badput bucket into productive
+        time; returns the amount actually moved. The fit loop's
+        compile-badput heuristic attributes the first step of every
+        program to `compile` AT THE TIME — a warm persistent
+        compilation cache makes that first step an ordinary cheap step,
+        which the loop detects only once it has steady-state steps to
+        compare against, and then corrects here. Only time recorded by
+        THIS incarnation can move (prior incarnations' attribution is
+        settled history)."""
+        s = max(float(seconds), 0.0)
+        with self._lock:
+            moved = min(s, self._badput.get(bucket, 0.0))
+            if moved <= 0.0:
+                return 0.0
+            self._badput[bucket] -= moved
+            if self._badput[bucket] <= 0.0:
+                del self._badput[bucket]
+            self._productive += moved
+            return moved
+
     @contextlib.contextmanager
     def measure_badput(self, bucket: str, clock=time.perf_counter):
         t0 = clock()

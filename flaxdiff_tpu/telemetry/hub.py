@@ -105,8 +105,10 @@ class Telemetry:
     def histogram(self, name: str, **kwargs):
         return self.registry.histogram(name, **kwargs)
 
-    def step_timer(self, mfu_meter=None) -> StepPhaseTimer:
-        return StepPhaseTimer(registry=self.registry, mfu_meter=mfu_meter)
+    def step_timer(self, mfu_meter=None,
+                   sample_every: int = 1) -> StepPhaseTimer:
+        return StepPhaseTimer(registry=self.registry, mfu_meter=mfu_meter,
+                              sample_every=sample_every)
 
     # -- tracing -------------------------------------------------------------
     def span(self, name: str, cat: str = "run",

@@ -70,7 +70,14 @@ def goodput_section(goodput: Dict, lines: List[str]) -> None:
 
 
 def phase_section(steps: List[Dict], lines: List[str]) -> None:
-    lines.append(f"== Step phases ({len(steps)} steps) ==")
+    # under sampled phase timing each row is a sample WINDOW (its
+    # `step` field is the closing step), so row count != step count:
+    # report both. Totals/% columns stay exact — window walls tile the
+    # run; mean/p50/p99 are per-row (per window when sampling).
+    n_steps = int(max((float(r.get("step", 0)) for r in steps),
+                      default=0))
+    lines.append(f"== Step phases ({len(steps)} rows, "
+                 f"~{n_steps} steps) ==")
     if not steps:
         lines.append("(no step_phases records — was the run telemetry-"
                      "enabled?)")
@@ -254,7 +261,12 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.json:
         wall = sum(float(r.get("wall", 0.0)) for r in steps)
-        doc = {"goodput": goodput, "steps": len(steps),
+        doc = {"goodput": goodput,
+               # max step number, not row count: under sampled phase
+               # timing rows are per-window
+               "steps": int(max((float(r.get("step", 0))
+                                 for r in steps), default=0)),
+               "phase_rows": len(steps),
                "step_wall_s": wall,
                "pod_last": (pods[-1] if pods else None),
                "health": {"numerics_rows": len(numerics),

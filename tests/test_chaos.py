@@ -175,6 +175,52 @@ def test_watchdog_quiet_on_healthy_run(mesh, rng):
     assert np.isfinite(hist["final_loss"])
 
 
+def test_step_nan_rollback_under_pipelined_loop(mesh, rng):
+    """ISSUE 5 satellite: the step.nan chaos scenario replayed under
+    the sync-free loop (pipeline_depth=2, sampled telemetry off-hub) —
+    the poisoned readback still lands in the loss window, still takes
+    the detector path, and still rolls back exactly once."""
+    ev = R.EventLog("chaos")
+    plan = R.FaultPlan(
+        [R.FaultSpec("step.nan", at=(3,), error="flag", times=1)])
+    with R.use_event_log(ev), plan.installed():
+        trainer = _make_trainer(mesh, pipeline_depth=2,
+                                telemetry_sample_every=4)
+        hist = trainer.fit(_data(rng), total_steps=8)
+    assert ev.count("fault_injected", "step.nan") == 1
+    assert ev.count("rollback", "train.step") == 1
+    assert np.isfinite(hist["final_loss"])
+
+
+def test_sigterm_checkpoints_last_settled_step_under_pipelining(
+        mesh, tmp_path, rng):
+    """Preemption under bounded in-flight dispatch: with up to 2 steps
+    in flight at SIGTERM time, the exit save must persist the last
+    SETTLED state — the checkpoint step equals the state's own step
+    counter (every dispatched step settles before orbax serializes),
+    never a torn in-between."""
+    ev = R.EventLog("chaos")
+    plan = R.FaultPlan(
+        [R.FaultSpec("host.sigterm", at=(4,), error="flag", times=1)])
+    with R.use_event_log(ev), plan.installed():
+        trainer = _make_trainer(mesh, tmp_path / "ck", event_log=ev,
+                                pipeline_depth=2)
+        hist = trainer.fit(_data(rng), total_steps=50, save_every=10)
+    assert hist["preempted"] is True
+    trainer.checkpointer.wait_until_finished()
+    saved = trainer.checkpointer.latest_step()
+    state_step = int(jax.device_get(trainer.state.step))
+    assert saved == state_step >= 4
+    # the saved state is fully settled and finite
+    restored = _make_trainer(mesh, tmp_path / "ck")
+    assert restored.restore_checkpoint() == saved
+    for leaf in jax.tree_util.tree_leaves(
+            jax.device_get(restored.state.params)):
+        assert np.all(np.isfinite(leaf))
+    trainer.checkpointer.close()
+    restored.checkpointer.close()
+
+
 def test_chaos_run_from_env_plan(mesh, monkeypatch, rng):
     """The env-driven arming path: FLAXDIFF_FAULT_PLAN JSON installs a
     plan without code changes (how a real chaos job arms itself)."""

@@ -1,0 +1,398 @@
+"""Sync-free pipelined training loop (ISSUE 5): bounded in-flight
+dispatch, the device-resident loss window, sampled phase timing, buffer
+donation, the in-graph save guard, and the warm-compile goodput fix.
+
+The load-bearing contract — "off-sample steps perform no
+block_until_ready and no scalar loss fetch" — is asserted by counting
+mocks over the trainer's ONLY sync primitives
+(`trainer._block_until_ready` / `trainer._fetch_losses`): a refactor
+that sneaks a per-step sync back in fails here instead of silently
+re-serializing the pipeline.
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from flaxdiff_tpu import telemetry as T
+from flaxdiff_tpu.predictors import EpsilonPredictionTransform
+from flaxdiff_tpu.schedulers import CosineNoiseSchedule
+from flaxdiff_tpu.trainer import Checkpointer, DiffusionTrainer, TrainerConfig
+from flaxdiff_tpu.trainer import trainer as trainer_mod
+
+
+def _make_trainer(mesh, tmp_path=None, telemetry=None, **cfg_kw):
+    import flax.linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, t, cond=None):
+            h = nn.Conv(8, (3, 3))(x)
+            return nn.Conv(x.shape[-1], (3, 3))(jnp.tanh(h))
+
+    model = Tiny()
+
+    def apply_fn(params, x, t, cond):
+        return model.apply({"params": params}, x, t, None)
+
+    def init_fn(key):
+        return model.init(key, jnp.zeros((1, 8, 8, 1)),
+                          jnp.zeros((1,)))["params"]
+
+    ckpt = Checkpointer(str(tmp_path)) if tmp_path is not None else None
+    return DiffusionTrainer(
+        apply_fn=apply_fn, init_fn=init_fn, tx=optax.adam(1e-3),
+        schedule=CosineNoiseSchedule(timesteps=100),
+        transform=EpsilonPredictionTransform(), mesh=mesh,
+        config=TrainerConfig(normalize=False, **cfg_kw),
+        checkpointer=ckpt, telemetry=telemetry)
+
+
+def _data(rng, batch=8):
+    while True:
+        yield {"sample": rng.normal(size=(batch, 8, 8, 1))
+               .astype(np.float32)}
+
+
+class _Counting:
+    """Counting wrapper that still performs the real call."""
+
+    def __init__(self, real):
+        self.real = real
+        self.calls = 0
+
+    def __call__(self, *a, **k):
+        self.calls += 1
+        return self.real(*a, **k)
+
+
+# -- buffer donation (satellite 1) --------------------------------------------
+
+def test_train_step_donates_state_buffers(mesh, rng):
+    """donate_argnums on the step program: the OLD state's buffers are
+    deleted after one step — a silent donation loss (argnums drift in a
+    refactor) doubles resident state and fails here."""
+    tr = _make_trainer(mesh)
+    old = tr.state
+    tr.train_step(next(_data(rng)))
+    leaves = [l for l in jax.tree_util.tree_leaves(old)
+              if isinstance(l, jax.Array)]
+    assert leaves
+    assert all(l.is_deleted() for l in leaves)
+    # the NEW state is alive and usable
+    assert np.isfinite(float(tr.train_step(next(_data(rng)))))
+
+
+def test_monitored_step_donates_identically(mesh, rng):
+    tr = _make_trainer(mesh, numerics_cadence=1)
+    old = tr.state
+    loss, aux = tr.train_step_monitored(next(_data(rng)))
+    leaves = [l for l in jax.tree_util.tree_leaves(old)
+              if isinstance(l, jax.Array)]
+    assert leaves
+    assert all(l.is_deleted() for l in leaves)
+    assert np.isfinite(float(loss))
+
+
+# -- sync counting (tentpole + satellite 3) -----------------------------------
+
+def test_offsample_steps_add_no_syncs(mesh, rng, tmp_path, monkeypatch):
+    """ISSUE 5 acceptance: telemetry enabled with sample_every > 1 —
+    off-sample steps perform NO block_until_ready and NO scalar loss
+    fetch. 8 steps, sample_every=4, log_every=8: dispatch closes only
+    on steps 1 (compile), 4 (sampled) and 8 (sampled + window fetch);
+    the loss window is fetched exactly once."""
+    block = _Counting(trainer_mod._block_until_ready)
+    fetch = _Counting(trainer_mod._fetch_losses)
+    monkeypatch.setattr(trainer_mod, "_block_until_ready", block)
+    monkeypatch.setattr(trainer_mod, "_fetch_losses", fetch)
+    tel = T.Telemetry.create(str(tmp_path / "tel"))
+    trainer = _make_trainer(
+        mesh, telemetry=tel, log_every=8,
+        telemetry_sample_every=4,
+        # depth > total_steps: the bounded-dispatch pop never triggers,
+        # isolating the telemetry sync policy under test (backpressure
+        # has its own test below)
+        pipeline_depth=16)
+    hist = trainer.fit(_data(rng), total_steps=8)
+    tel.close()
+    assert np.isfinite(hist["final_loss"])
+    assert block.calls == 3          # steps 1, 4, 8 — never off-sample
+    assert fetch.calls == 1          # one host sync per log window
+
+    # the JSONL rows show the window shape: ONE row per sample window
+    # (off-sample steps emit nothing — their phases ride in the sampled
+    # step's window sums), each row summing to its WINDOW's wall-clock
+    recs = [json.loads(x)
+            for x in open(tmp_path / "tel" / "telemetry.jsonl")]
+    steps = [r for r in recs if r.get("type") == "step_phases"]
+    assert sorted(int(r["step"]) for r in steps) == [1, 4, 8]
+    assert all("device" in r for r in steps)
+    for r in steps:
+        parts = sum(v for k, v in r.items()
+                    if k not in ("type", "step", "wall", "_time"))
+        assert parts == pytest.approx(r["wall"], rel=1e-3, abs=1e-5)
+    # the three windows tile the run: window walls sum to ~the 8 steps'
+    # total wall-clock (no step's time is dropped from the rows)
+    assert sum(r["wall"] for r in steps) > 0
+
+
+def test_save_cadence_performs_no_loss_fetch(mesh, rng, tmp_path,
+                                             monkeypatch):
+    """Satellite 3 (counting half): with the in-graph gate (default)
+    the save path calls neither block_until_ready nor a loss fetch —
+    the only fetches are the per-window ones. The legacy path
+    (gate_nonfinite=False) still pays one fetch per save."""
+    fetch = _Counting(trainer_mod._fetch_losses)
+    monkeypatch.setattr(trainer_mod, "_fetch_losses", fetch)
+    trainer = _make_trainer(mesh, tmp_path / "ck", log_every=4,
+                            pipeline_depth=2)
+    trainer.fit(_data(rng), total_steps=8, save_every=2)
+    trainer.checkpointer.wait_until_finished()
+    trainer.checkpointer.close()
+    assert fetch.calls == 2          # windows at steps 4 and 8; saves free
+
+    fetch2 = _Counting(trainer_mod._fetch_losses)
+    monkeypatch.setattr(trainer_mod, "_fetch_losses", fetch2)
+    legacy = _make_trainer(mesh, tmp_path / "ck_legacy", log_every=4,
+                           gate_nonfinite=False)
+    legacy.fit(_data(rng), total_steps=8, save_every=2)
+    legacy.checkpointer.wait_until_finished()
+    legacy.checkpointer.close()
+    assert fetch2.calls == 2 + 4     # + one per save (steps 2, 4, 6, 8)
+
+
+def test_nan_step_never_reaches_checkpoint(mesh, rng, tmp_path):
+    """Satellite 3 (semantics half): a poisoned batch at step N, a save
+    at step N — without any loss fetch the checkpointed state must
+    still be finite, because the in-graph gate withheld the poisoned
+    update. The window fetch then surfaces the transient as a
+    window_nonfinite event."""
+    from flaxdiff_tpu import resilience as R
+
+    def data():
+        src = _data(rng)
+        for i, batch in enumerate(src):
+            if i == 1:          # consumed by step 2 == the save step
+                batch = {"sample": np.full((8, 8, 8, 1), np.nan,
+                                           np.float32)}
+            yield batch
+
+    ev = R.EventLog("pipeline")
+    with R.use_event_log(ev):
+        trainer = _make_trainer(mesh, tmp_path / "ck", log_every=4,
+                                pipeline_depth=2)
+        hist = trainer.fit(data(), total_steps=4, save_every=2)
+        trainer.checkpointer.wait_until_finished()
+    assert np.isfinite(hist["final_loss"])
+    # the poisoned step's loss was visible in the window...
+    assert ev.count("window_nonfinite", "train.step") == 1
+    # ...but the update never landed: the step-2 checkpoint is finite
+    restored = _make_trainer(mesh, tmp_path / "ck")
+    restored.restore_checkpoint(step=2)
+    for leaf in jax.tree_util.tree_leaves(
+            jax.device_get(restored.state.params)):
+        assert np.all(np.isfinite(leaf))
+    trainer.checkpointer.close()
+    restored.checkpointer.close()
+
+
+# -- bounded in-flight dispatch -----------------------------------------------
+
+def test_backpressure_bounds_inflight_dispatch(mesh, rng, monkeypatch):
+    """pipeline_depth is a real bound: when the oldest in-flight step
+    never reports ready (forced via the _is_ready seam), every step
+    past the depth waits on it — counted both by the mock and the
+    pipeline/backpressure_waits counter."""
+    block = _Counting(trainer_mod._block_until_ready)
+    monkeypatch.setattr(trainer_mod, "_block_until_ready", block)
+    monkeypatch.setattr(trainer_mod, "_is_ready", lambda x: False)
+    hub = T.Telemetry(enabled=False)
+    with T.use_telemetry(hub):
+        trainer = _make_trainer(mesh, log_every=100, pipeline_depth=2)
+        trainer.fit(_data(rng), total_steps=10)
+    # steps 3..10 each popped one over-depth entry
+    assert block.calls == 8
+    assert hub.counter("pipeline/backpressure_waits").value == 8
+
+
+def test_healthy_cpu_pipeline_never_backpressures(mesh, rng):
+    """On the (near-synchronous) CPU backend the non-blocking readiness
+    check finds the oldest step settled — the bound costs a host query,
+    not a wait."""
+    hub = T.Telemetry(enabled=False)
+    with T.use_telemetry(hub):
+        trainer = _make_trainer(mesh, log_every=5, pipeline_depth=2)
+        hist = trainer.fit(_data(rng), total_steps=10)
+    assert np.isfinite(hist["final_loss"])
+    assert hub.counter("pipeline/backpressure_waits").value == 0
+
+
+# -- sampled timer + goodput window semantics ---------------------------------
+
+def test_step_timer_sample_every_pattern_and_meter_window():
+    from flaxdiff_tpu.profiling import MFUMeter
+    meter = MFUMeter(flops_per_step=1e9, peak_flops=1e12)
+    timer = T.StepPhaseTimer(mfu_meter=meter, sample_every=4)
+    sampled = []
+    for step in range(1, 9):
+        timer.begin_step(step)
+        sampled.append(timer.sampled)
+        if timer.sampled:
+            with timer.phase("device"):
+                time.sleep(0.002)
+        timer.end_step()
+    # step 1 always sampled (compile evidence), then every 4th
+    assert sampled == [True, False, False, True,
+                       False, False, False, True]
+    # the meter saw 3 device closes covering all 8 steps: window
+    # semantics keep mean_step_time per-step
+    assert meter.steps == 8
+    assert meter.mean_step_time() < 0.004
+
+
+def test_step_timer_mark_sampled_and_validation():
+    timer = T.StepPhaseTimer(sample_every=8)
+    timer.begin_step(3)
+    assert not timer.sampled
+    timer.mark_sampled()
+    assert timer.sampled
+    timer.end_step()
+    with pytest.raises(ValueError, match="sample_every"):
+        T.StepPhaseTimer(sample_every=0)
+
+
+def test_goodput_closes_under_sampling_and_pipelining(mesh, tmp_path, rng):
+    """Satellite 6: window-granularity attribution still closes — with
+    sample_every=4 and pipeline_depth=2 the productive+badput account
+    sums to fit wall-clock within 5% on CPU."""
+    tel = T.Telemetry.create(str(tmp_path / "tel"))
+    with T.use_telemetry(tel):
+        trainer = _make_trainer(mesh, tmp_path / "ck", telemetry=tel,
+                                log_every=4, telemetry_sample_every=4,
+                                pipeline_depth=2)
+        t0 = time.perf_counter()
+        hist = trainer.fit(_data(rng), total_steps=12, save_every=4)
+        wall = time.perf_counter() - t0
+        trainer.checkpointer.wait_until_finished()
+    tel.close()
+    trainer.checkpointer.close()
+    g = json.load(open(tmp_path / "tel" / "goodput.json"))
+    attributed = g["productive_s"] + sum(g["badput_s"].values())
+    assert abs(attributed - wall) / wall < 0.05, (attributed, wall)
+    assert hist["goodput"]["productive_s"] > 0
+
+
+# -- warm-compile reclassification (satellite 2) ------------------------------
+
+def test_cold_compile_stays_badput_warm_becomes_productive(mesh, rng):
+    """The admitted heuristic bug, fixed: a COLD first step (real jit
+    compile, much slower than steady state) stays compile badput; a
+    WARM first step (second fit of the same program — the same shape a
+    persistent compilation cache produces across processes) is
+    re-attributed productive."""
+    from flaxdiff_tpu import resilience as R
+    ev = R.EventLog("warm")
+    with R.use_event_log(ev):
+        trainer = _make_trainer(mesh, log_every=5)
+        h_cold = trainer.fit(_data(rng), total_steps=10)
+        h_warm = trainer.fit(_data(rng), total_steps=10)
+    assert h_cold["goodput"]["badput_s"].get("compile", 0.0) > 0
+    assert h_warm["goodput"]["badput_s"].get("compile", 0.0) == 0
+    assert ev.count("warm_compile_reclassified", "train.step") == 1
+
+
+def test_goodput_reattribute_moves_and_caps():
+    g = T.GoodputLedger()
+    g.record_badput("compile", 2.0)
+    g.record_productive(1.0)
+    assert g.reattribute("compile", 1.5) == pytest.approx(1.5)
+    t = g.totals()
+    assert t["productive_s"] == pytest.approx(2.5)
+    assert t["badput_s"]["compile"] == pytest.approx(0.5)
+    # capped at what the bucket holds; empty bucket drops out
+    assert g.reattribute("compile", 9.0) == pytest.approx(0.5)
+    assert "compile" not in g.totals()["badput_s"]
+    assert g.reattribute("compile", 1.0) == 0.0
+    assert g.totals()["total_s"] == pytest.approx(3.0)   # conserved
+
+
+def test_compilation_cache_cli(tmp_path):
+    """train.py --compilation_cache_dir wires jax's persistent cache
+    (and parse_args accepts the r5 loop knobs)."""
+    import train as train_cli
+    args = train_cli.parse_args(
+        ["--compilation_cache_dir", str(tmp_path / "cache"),
+         "--pipeline_depth", "4", "--telemetry_sample_every", "8",
+         "--no_nonfinite_gate"])
+    assert args.pipeline_depth == 4
+    assert args.telemetry_sample_every == 8
+    assert args.no_nonfinite_gate is True
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        assert train_cli.configure_compilation_cache(
+            str(tmp_path / "cache"))
+        assert jax.config.jax_compilation_cache_dir == \
+            str(tmp_path / "cache")
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+# -- upload prefetch ----------------------------------------------------------
+
+class TestPrefetchToDevice:
+    def test_order_preserved_and_close_joins_worker(self):
+        from flaxdiff_tpu.data.prefetch import prefetch_to_device
+        consumed = []
+
+        def src():
+            for i in range(100):
+                consumed.append(i)
+                yield i
+
+        pf = prefetch_to_device(lambda x: x * 10, src(), depth=2)
+        got = [next(pf) for _ in range(5)]
+        assert got == [0, 10, 20, 30, 40]
+        pf.close()
+        assert not pf._thread.is_alive()
+        # bounded lookahead: at most depth+1 items beyond what was read
+        assert len(consumed) <= 5 + 3
+
+    def test_source_exhaustion_raises_stopiteration(self):
+        from flaxdiff_tpu.data.prefetch import prefetch_to_device
+        pf = prefetch_to_device(lambda x: x, iter([1, 2]), depth=2)
+        assert [x for x in pf] == [1, 2]
+        pf.close()
+
+    def test_transform_error_surfaces_at_consumer(self):
+        from flaxdiff_tpu import resilience as R
+        from flaxdiff_tpu.data.prefetch import prefetch_to_device
+
+        def boom(x):
+            raise RuntimeError("upload failed")
+
+        ev = R.EventLog("pf")
+        with R.use_event_log(ev):
+            pf = prefetch_to_device(boom, iter([1]), depth=1)
+            with pytest.raises(RuntimeError, match="upload failed"):
+                next(pf)
+            pf.close()
+        assert ev.count("pipeline_error", "data.put_batch") == 1
+
+
+def test_fit_releases_shared_iterator_on_return(mesh, rng):
+    """fit must leave the caller's iterator safe to consume from the
+    caller's thread (train.py pulls validation batches between fit
+    chunks) — the upload worker is joined before fit returns."""
+    import threading
+    it = _data(rng)
+    trainer = _make_trainer(mesh, log_every=2)
+    trainer.fit(it, total_steps=3)
+    assert not any(t.name == "flaxdiff-put-batch" and t.is_alive()
+                   for t in threading.enumerate())
+    batch = next(it)                  # no "generator already executing"
+    assert batch["sample"].shape == (8, 8, 8, 1)

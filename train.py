@@ -114,8 +114,37 @@ def parse_args(argv=None):
                         "a cumulative goodput/badput account in "
                         "goodput.json, and host-side spans in a "
                         "Perfetto-loadable trace.json. Costs one device "
-                        "sync per step (exact device-phase timing). "
+                        "sync per SAMPLED step (exact device-phase "
+                        "timing; --telemetry_sample_every thins it). "
                         "Analyze with scripts/diagnose_run.py")
+    p.add_argument("--telemetry_sample_every", type=int, default=1,
+                   help="with --telemetry_dir, close async dispatch for "
+                        "exact device-phase timing only every N-th step "
+                        "— off-sample steps add zero host syncs and "
+                        "phase/goodput attribution moves to window "
+                        "granularity (docs/OBSERVABILITY.md 'Sampled "
+                        "phase timing'). 1 = per-step exact timing")
+    p.add_argument("--pipeline_depth", type=int, default=2,
+                   help="bounded-depth asynchronous dispatch: the fit "
+                        "loop keeps up to N steps in flight so the "
+                        "device pipeline stays full across step "
+                        "boundaries; 0 disables the bound (the "
+                        "log-cadence loss fetch is then the only "
+                        "settle point)")
+    p.add_argument("--no_nonfinite_gate", action="store_true",
+                   help="disable the in-graph non-finite gate (an "
+                        "elementwise select that keeps the previous "
+                        "value wherever an update is non-finite, so "
+                        "the live state is finite by construction); "
+                        "disabling restores the legacy synchronous "
+                        "save-cadence loss check")
+    p.add_argument("--compilation_cache_dir", default=None,
+                   help="persistent XLA compilation cache directory: "
+                        "relaunches (and coordinated restarts) reload "
+                        "compiled programs instead of paying the jit "
+                        "compile again — the fit loop detects the warm "
+                        "first step and attributes it productive "
+                        "instead of compile badput")
     p.add_argument("--prometheus_textfile", default=None,
                    help="also export the telemetry snapshot to this path "
                         "in Prometheus text format (atomic rename; "
@@ -174,6 +203,33 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
+def configure_compilation_cache(cache_dir):
+    """Enable JAX's persistent compilation cache rooted at `cache_dir`.
+
+    Thresholds are zeroed so even small programs (the monitored twin,
+    eval samplers) cache — a coordinated restart then pays ~no compile
+    badput, and the trainer's warm-first-step reclassification keeps
+    the goodput account honest about it. Returns True when the cache
+    was configured (False on a jax too old to support it — the run
+    proceeds uncached rather than dying)."""
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        try:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+        except AttributeError:
+            pass        # knob added after the min_compile_time one
+    except AttributeError:
+        import warnings
+        warnings.warn("this jax has no persistent compilation cache "
+                      "config; --compilation_cache_dir ignored",
+                      stacklevel=2)
+        return False
+    return True
+
+
 def main(argv=None):
     args = parse_args(argv)
 
@@ -181,6 +237,8 @@ def main(argv=None):
 
     from flaxdiff_tpu.utils import apply_jax_platforms_env
     apply_jax_platforms_env()
+    if args.compilation_cache_dir:
+        configure_compilation_cache(args.compilation_cache_dir)
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -513,7 +571,11 @@ def main(argv=None):
                              flat_params=args.flat_params,
                              watchdog_timeout=args.watchdog_timeout,
                              numerics_cadence=args.numerics_cadence,
-                             anomaly_action=args.anomaly_action),
+                             anomaly_action=args.anomaly_action,
+                             pipeline_depth=args.pipeline_depth,
+                             telemetry_sample_every=(
+                                 args.telemetry_sample_every),
+                             gate_nonfinite=not args.no_nonfinite_gate),
         policy=policy, null_cond=null_cond, checkpointer=ckpt,
         autoencoder=autoencoder, telemetry=telemetry)
 
