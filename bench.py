@@ -239,17 +239,29 @@ def _backend_died(e: Exception) -> bool:
 
 
 def _sweep_body(image_size: int, depths: tuple,
-                sweep: tuple, timed: int) -> dict:
+                sweep: tuple, timed: int,
+                remat_axis: bool = False) -> dict:
     """Shared batch-sweep core for the 128^2 flagship and 256^2
     north-star stages: every attempted batch lands in per_batch with a
     number or its full failure cause; failed batches retry with
     remat=True (pins memory as the cause — VERDICT r3 weak #4). A
     backend death ABORTS the sweep but the already-measured cells are
     still returned ("aborted" carries the cause) — evidence must
-    survive the tunnel dying mid-sweep."""
+    survive the tunnel dying mid-sweep.
+
+    Every successful cell also records the HBM high-water mark from
+    `telemetry/memory.py` (allocator peak_bytes_in_use, fullest chip).
+    The allocator peak is monotonic per process, so a cell whose peak
+    did not move above the sweep's running maximum is flagged
+    `hbm_peak_masked` — its true peak is hidden under an earlier,
+    bigger cell's. With `remat_axis`, the winning batch's OTHER remat
+    setting is measured as an addendum so the sweep JSON carries the
+    remat on/off step-time + HBM trade at the headline batch (ROADMAP
+    item-2 follow-up)."""
     import jax
 
     from flaxdiff_tpu.profiling import device_peak_flops, mfu
+    from flaxdiff_tpu.telemetry.memory import MemoryMonitor
 
     cpu = jax.devices()[0].platform == "cpu"
     n_chips = jax.local_device_count()
@@ -260,6 +272,8 @@ def _sweep_body(image_size: int, depths: tuple,
     per_batch = {}
     best = None  # (ips, batch, step_time, flops_hw, remat)
     aborted = None
+    memory = MemoryMonitor()
+    hbm_seen = [0.0]    # sweep-running allocator peak (masking flag)
 
     def attempt(batch, remat):
         nonlocal best, aborted
@@ -291,6 +305,15 @@ def _sweep_body(image_size: int, depths: tuple,
             "step_time_ms": round(step_time * 1e3, 2),
             "mfu_hw": None if m_hw is None else round(m_hw, 4),
             "remat": remat}
+        snap = memory.sample()
+        if snap:
+            hbm_peak = snap.get("memory/peak_bytes_in_use", 0.0)
+            per_batch[key]["hbm_peak_gib"] = round(hbm_peak / 2 ** 30, 3)
+            if hbm_peak <= hbm_seen[0]:
+                # allocator peaks are process-monotonic: this cell's
+                # own peak is hidden under an earlier cell's
+                per_batch[key]["hbm_peak_masked"] = True
+            hbm_seen[0] = max(hbm_seen[0], hbm_peak)
         log(f"batch {key}: {ips:.2f} imgs/s/chip, "
             f"step {step_time * 1e3:.1f} ms, mfu_hw "
             f"{m_hw if m_hw is None else round(m_hw, 3)}")
@@ -333,8 +356,22 @@ def _sweep_body(image_size: int, depths: tuple,
         failures = 0 if ok_r else failures + 1
         if failures >= 2:
             break
+    remat_cells = None
+    if remat_axis and best is not None and aborted is None:
+        # the remat-policy axis: measure the headline batch's OTHER
+        # remat setting so both cells exist side by side (step time +
+        # HBM peak = the compute/memory trade, in one JSON)
+        b_batch, b_remat = best[1], best[4]
+        other_key = str(b_batch) if b_remat else f"{b_batch}_remat"
+        if other_key not in per_batch:
+            attempt(b_batch, remat=not b_remat)
+        on_key, off_key = f"{b_batch}_remat", str(b_batch)
+        remat_cells = {"batch": b_batch,
+                       "off": per_batch.get(off_key),
+                       "on": per_batch.get(on_key)}
     return {"per_batch": per_batch, "best": best,
-            "cpu": cpu, "peak": peak, "aborted": aborted}
+            "cpu": cpu, "peak": peak, "aborted": aborted,
+            "remat_axis": remat_cells}
 
 
 def stage_sweep(args) -> dict:
@@ -350,7 +387,8 @@ def stage_sweep(args) -> dict:
     sweep = ((4,) if cpu else
              (BASELINE_BATCH,) if args.quick else BATCH_SWEEP)
 
-    core = _sweep_body(image_size, (64, 128, 256, 512), sweep, timed)
+    core = _sweep_body(image_size, (64, 128, 256, 512), sweep, timed,
+                       remat_axis=True)
     if core["best"] is None:
         # no throughput number, but the per-batch causes ARE the result
         return {"platform": jax.devices()[0].platform,
@@ -434,6 +472,7 @@ def stage_sweep(args) -> dict:
                    if flops and peak else None),
         "mfu_model": (round(mfu(model_flops, step_time, peak), 4)
                       if model_flops and peak else None),
+        "remat_axis": core.get("remat_axis"),
         "trace_dir": trace_dir if traced else None,
         "aborted": core["aborted"],
     }
@@ -742,12 +781,22 @@ def stage_attnpad(args) -> dict:
     k = jax.random.normal(jax.random.PRNGKey(1), (B, L, H, D), jnp.bfloat16)
     v = jax.random.normal(jax.random.PRNGKey(2), (B, L, H, D), jnp.bfloat16)
 
-    res = {"platform": "tpu", "shape": [B, L, H, D]}
+    res = {"platform": "tpu", "shape": [B, L, H, D],
+           # record the block env the cells run under (flashtune's
+           # exported winner) so the native-vs-padded delta is
+           # attributable to the head-dim choice alone
+           "block_env": {"q": os.environ.get("FLAXDIFF_FLASH_BLOCK_Q"),
+                         "k": os.environ.get("FLAXDIFF_FLASH_BLOCK_K")}}
     # this stage OWNS the native-d toggle: flashtune's exported winner
     # may carry NATIVE_D=1, which would make the "padded" run silently
     # measure the native kernel and zero out the very comparison this
     # stage exists to make
     os.environ.pop("FLAXDIFF_FLASH_NATIVE_D", None)
+    # the per-shape autotuner cache could also flip native-d under this
+    # stage's feet — same ownership rule as the env toggle above
+    os.environ.pop("FLAXDIFF_FLASH_TUNE_CACHE", None)
+    from flaxdiff_tpu.ops import autotune as _autotune
+    _autotune.deactivate()
     res["flash_padded_ms"] = round(chained_grad_ms("flash", q, k, v), 3)
     res["xla_d64_ms"] = round(chained_grad_ms("xla", q, k, v), 3)
     try:
@@ -764,27 +813,120 @@ def stage_attnpad(args) -> dict:
 
 
 def chained_grad_ms(backend: str, q0, k, v, iters: int = 30) -> float:
-    """Time one attention fwd+bwd via jit(grad): compile+sync first, then
-    `iters` steps with each iteration's dq fed into the next q (so no
-    execution can be elided), synced by a SCALAR READBACK —
-    block_until_ready on this tunneled backend returned before
-    completion (r3), "timing" micro-benches at 3x the chip's peak FLOP
-    rate. Shared by the flashtune and attnpad stages so their harness
-    stays identical and differences are kernel differences."""
+    """Time one attention fwd+bwd via jit(grad) with the chained-dq /
+    scalar-readback harness, now factored into
+    flaxdiff_tpu/ops/autotune.py (the autotuner probes with the SAME
+    harness, so bench numbers and tuner decisions cannot drift). This
+    wrapper keeps the bench's backend-string interface for the
+    flashtune/attnpad/longseq stages."""
     import jax
 
     from flaxdiff_tpu.ops.attention import dot_product_attention
+    from flaxdiff_tpu.ops.autotune import chained_grad_ms as _chained
 
     def loss(q, k, v):
         return dot_product_attention(q, k, v, backend=backend).sum()
     g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-    qi = q0
-    float(jax.device_get(g(qi, k, v)[0].sum()))   # compile + sync
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        qi = g(qi, k, v)[0]
-    float(jax.device_get(qi.sum()))
-    return (time.perf_counter() - t0) / iters * 1e3
+    return _chained(lambda q, k, v: g(q, k, v)[0], q0, k, v, iters)
+
+
+def stage_epilogue(args) -> dict:
+    """Fused vs unfused transformer-epilogue micro-bench
+    (ops/fused_adaln.py): the AdaLN dual-view LayerNorm+modulate, the
+    gated residual, and the GEGLU activation, each timed fwd+bwd with
+    the chained-grad harness, plus an analytic estimate of the HBM
+    bytes each variant moves (the fused ops exist to cut activation
+    round trips, so the bytes model IS the claim being measured).
+
+    Runs on CPU too: the fused dispatch falls back to XLA off-TPU, so
+    the cpu ratio is ~1.0 by construction — recorded as harness
+    evidence (`fused_is_xla_fallback`), never passed off as a kernel
+    win. On TPU the fused cells run the real Pallas kernels
+    (force_pallas), the unfused cells the exact XLA composition."""
+    _apply_jax_platforms()
+    import jax
+    import jax.numpy as jnp
+
+    from flaxdiff_tpu.ops import fused_adaln as fa
+    from flaxdiff_tpu.ops.autotune import chained_grad_ms as _chained
+
+    cpu = jax.devices()[0].platform == "cpu"
+    on_tpu = not cpu
+    if cpu or args.quick:
+        B, L, C, iters = 2, 256, 128, 5
+        dt = jnp.float32
+    else:
+        B, L, C, iters = 8, 1024, 768, 30
+        dt = jnp.bfloat16
+    F = C * 4
+    bpe = jnp.dtype(dt).itemsize
+    key = jax.random.PRNGKey
+    x = jax.random.normal(key(0), (B, L, C), dt)
+    s1 = jax.random.normal(key(1), (B, 1, C), dt) * 0.1
+    b1 = jax.random.normal(key(2), (B, 1, C), dt) * 0.1
+    s2 = jax.random.normal(key(3), (B, 1, C), dt) * 0.1
+    b2 = jax.random.normal(key(4), (B, 1, C), dt) * 0.1
+    gate = jax.random.normal(key(5), (B, 1, C), dt) * 0.1
+    h = jax.random.normal(key(6), (B, L, C), dt)
+    proj = jax.random.normal(key(7), (B, L, 2 * F), dt)
+
+    def timed(fn, x0, *rest):
+        """fwd+bwd wrt the chained first operand (dx feeds the next x,
+        so nothing elides) — the flashtune harness, on epilogues."""
+        g = jax.jit(jax.grad(
+            lambda a, *r: fn(a, *r).astype(jnp.float32).sum()))
+        return round(_chained(lambda a, k_, v_: g(a, *rest), x0, None,
+                              None, iters=iters), 3)
+
+    blc = B * L * C * bpe
+    configs = {
+        # (fused fn, unfused fn, chained operand, extra args,
+        #  est bytes fused, est bytes unfused)
+        "adaln_dual": (
+            lambda a, *r: sum(fa.fused_ln_modulate2(
+                a, *r, 1e-5, False, on_tpu)),
+            lambda a, *r: sum(fa._xla_ln_modulate(
+                a, ((r[0], r[1]), (r[2], r[3])), 1e-5)),
+            x, (s1, b1, s2, b2),
+            # fused: read x, write 2 views (+[B,L,1] stats)
+            3 * blc,
+            # unfused: read x, write norm, read norm x2, write 2 views
+            6 * blc),
+        "gate_residual": (
+            lambda a, *r: fa.fused_gate_residual(a, r[0], r[1],
+                                                 False, on_tpu),
+            lambda a, *r: a + r[0] * r[1],
+            x, (gate, h),
+            3 * blc, 3 * blc),
+        "geglu": (
+            lambda a: fa.fused_geglu(a, False, on_tpu),
+            fa._xla_geglu,
+            proj, (),
+            3 * B * L * F * bpe, 3 * B * L * F * bpe),
+    }
+    res = {"platform": jax.devices()[0].platform,
+           "shape": [B, L, C], "dtype": str(jnp.dtype(dt)),
+           "fused_is_xla_fallback": not on_tpu,
+           "configs": {}}
+    for name, (fused_fn, plain_fn, x0, rest, est_f, est_u) in \
+            configs.items():
+        cell = {"est_hbm_mb_fused": round(est_f / 2 ** 20, 2),
+                "est_hbm_mb_unfused": round(est_u / 2 ** 20, 2)}
+        for label, fn in (("fused_ms", fused_fn),
+                          ("unfused_ms", plain_fn)):
+            try:
+                cell[label] = timed(fn, x0, *rest)
+            except Exception:
+                cell[label] = None
+                cell[label.replace("_ms", "_error")] = \
+                    traceback.format_exc()[-300:]
+        if cell.get("fused_ms") and cell.get("unfused_ms"):
+            cell["ratio_fused_over_unfused"] = round(
+                cell["fused_ms"] / cell["unfused_ms"], 3)
+        res["configs"][name] = cell
+        log(f"epilogue {name}: {cell}")
+        print(json.dumps(res), flush=True)   # salvage point
+    return res
 
 
 def stage_flashtune(args) -> dict:
@@ -905,8 +1047,27 @@ def stage_flashtune(args) -> dict:
         best["ms_prebuilt"] = flag["prebuilt"]
     else:
         best["impl"] = "firstparty"
-    return {"platform": "tpu", "shape": [B, L, H, D],
-            "results_ms": results, "head_to_head_ms": h2h, "best": best}
+    out = {"platform": "tpu", "shape": [B, L, H, D],
+           "results_ms": results, "head_to_head_ms": h2h, "best": best}
+    # Persist the flagship winner into the per-shape autotuner cache
+    # (ops/autotune.py): later tuned stages — and any training run
+    # pointed at the same dir — pick the plan up per shape instead of
+    # via the global env pair. The ladder results ride along as
+    # evidence.
+    try:
+        from flaxdiff_tpu.ops.autotune import FlashAutotuner
+        cache_dir = os.environ.get("FLAXDIFF_FLASH_TUNE_CACHE",
+                                   "flash_tune_cache")
+        aut = FlashAutotuner(cache_dir=cache_dir)
+        aut.record(L, L, D, "bfloat16", best["block_q"], best["block_k"],
+                   best.get("native_d", 0), ms=best["ms"],
+                   probed_ms={kk: vv for kk, vv in results.items()
+                              if isinstance(vv, float)})
+        aut.save()
+        out["autotune_cache"] = cache_dir
+    except Exception:
+        out["autotune_cache_error"] = traceback.format_exc()[-300:]
+    return out
 
 
 def stage_ablate(args) -> dict:
@@ -965,6 +1126,11 @@ def stage_ablate(args) -> dict:
     # fused and grads arrive flat (the r3 trace's ~10 ms / 327-kernel
     # leaf-wise-update budget, measured in-context)
     for key, kwargs, env_add in (
+            # fused-epilogue A/B in-context (the flagship UNet's GEGLU
+            # FF rides ops/fused_adaln.py on TPU by default; =xla
+            # restores the unfused composition — mirrors norm=xla)
+            ("attn=flash,norm=pallas,adaln=xla", {},
+             {"FLAXDIFF_FUSED_ADALN": "xla"}),
             ("attn=flash,norm=pallas,opt=flat", dict(flat_opt=True), {}),
             ("attn=flash,norm=pallas,opt=flatparams",
              dict(flat_params=True), {}),
@@ -1234,7 +1400,7 @@ STAGES = {"flashtune": stage_flashtune, "sweep": stage_sweep,
           "refreal": stage_refreal,
           "ddim": stage_ddim, "attnpad": stage_attnpad,
           "ablate": stage_ablate, "longseq": stage_longseq,
-          "dispatch": stage_dispatch}
+          "dispatch": stage_dispatch, "epilogue": stage_epilogue}
 
 # info-value order (VERDICT r3 next #1): the headline sweep first, its
 # baseline second; refreal anchors vs_reference_binary; dispatch is the
@@ -1242,7 +1408,8 @@ STAGES = {"flashtune": stage_flashtune, "sweep": stage_sweep,
 # cheap and unblocks the tuned micros; ddim is the BASELINE.md
 # inference target; the rest are diagnostics.
 STAGE_ORDER = ("sweep", "ref", "refreal", "dispatch", "flashtune",
-               "ddim", "attnpad", "ablate", "sweep256", "longseq")
+               "ddim", "attnpad", "epilogue", "ablate", "sweep256",
+               "longseq")
 
 # rough healthy-tunnel cost estimates (seconds) for budget scheduling —
 # a stage is skipped when the remaining budget can't cover its MINIMUM
@@ -1254,6 +1421,9 @@ STAGE_ORDER = ("sweep", "ref", "refreal", "dispatch", "flashtune",
 # (4 shapes x 2 impls, each a fresh compile)
 STAGE_EST = {"sweep": 900, "ref": 450, "refreal": 700, "flashtune": 500,
              "ddim": 600, "attnpad": 90, "ablate": 1100, "sweep256": 800,
+             # 3 epilogue chains x 2 variants, each one small jit(grad)
+             # compile + `iters` chained steps
+             "epilogue": 240,
              "longseq": 550,   # + r5 on-chip 16k correctness cell
              # 9 tiny-model fit cells (3 depths x 3 telemetry modes),
              # each ~steps x a-few-ms + one tiny-model compile
@@ -1263,6 +1433,8 @@ STAGE_EST = {"sweep": 900, "ref": 450, "refreal": 700, "flashtune": 500,
 # (sweep/ref/ddim/sweep256) run with code defaults: an unvalidated
 # winner must never be able to take down the headline number (the r4
 # mid-round session exported native_d to the sweep and lost it).
+# epilogue is deliberately NOT tuned: its chains contain no attention,
+# so the flashtune winner env / autotune cache cannot affect it
 TUNED_STAGES = ("attnpad", "ablate", "longseq", "refreal")
 
 
@@ -1278,6 +1450,12 @@ def export_winner_env(env: dict, stages: dict) -> dict:
         add["FLAXDIFF_FLASH_BLOCK_K"] = str(best["block_k"])
         if best.get("native_d"):
             add["FLAXDIFF_FLASH_NATIVE_D"] = "1"
+        cache = stages.get("flashtune", {}).get("autotune_cache")
+        if cache:
+            # per-shape plans for every OTHER attention shape the tuned
+            # stages hit (the env pair above still wins where set —
+            # autotuner env-precedence rule)
+            add["FLAXDIFF_FLASH_TUNE_CACHE"] = cache
         # deliberately NOT exporting FLAXDIFF_FLASH_IMPL: the ablate
         # stage measures the impl choice as its own explicit cell
         # (attn=prebuilt) — an env switch would silently change the

@@ -185,20 +185,30 @@ class AttentionLayer(nn.Module):
 
 
 class GEGLUFeedForward(nn.Module):
-    """GEGLU-gated MLP (reference attention.py:179-238)."""
+    """GEGLU-gated MLP (reference attention.py:179-238).
+
+    With `fused` (default) the split + gelu + multiply over the packed
+    [.., 2F] projection runs as one Pallas pass on TPU
+    (ops/fused_adaln.py fused_geglu; FLAXDIFF_FUSED_ADALN=xla|interpret
+    A/B); off-TPU the exact composition below runs."""
 
     dim_out: int
     mult: int = 4
     dtype: Optional[Dtype] = None
     precision: Optional[jax.lax.Precision] = None
+    fused: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        from ..ops.fused_adaln import fused_adaln_active, fused_geglu
         inner = self.dim_out * self.mult
         proj = nn.Dense(inner * 2, dtype=self.dtype, precision=self.precision,
                         name="proj_in")(x)
-        gate, val = jnp.split(proj, 2, axis=-1)
-        x = val * jax.nn.gelu(gate)
+        if self.fused and fused_adaln_active() and proj.ndim == 3:
+            x = fused_geglu(proj)
+        else:
+            gate, val = jnp.split(proj, 2, axis=-1)
+            x = val * jax.nn.gelu(gate)
         return nn.Dense(self.dim_out, dtype=self.dtype,
                         precision=self.precision, name="proj_out")(x)
 

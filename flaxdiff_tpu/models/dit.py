@@ -15,6 +15,11 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ..ops.fused_adaln import (
+    fused_adaln_active,
+    fused_gate_residual,
+    fused_ln_modulate,
+)
 from ..typing import Dtype
 from .sfc import sfc_unpatchify, unpatchify
 from .vit_common import (
@@ -29,7 +34,15 @@ from .vit_common import (
 
 class DiTBlock(nn.Module):
     """AdaLN-Zero-modulated transformer block: gated RoPE self-attention +
-    gated MLP (reference simple_dit.py:23-95)."""
+    gated MLP (reference simple_dit.py:23-95).
+
+    With `fused_epilogues` (default) the LayerNorm+modulate prologues and
+    the gated residuals run as single fused Pallas passes on TPU
+    (ops/fused_adaln.py); off-TPU — and under FLAXDIFF_FUSED_ADALN=xla —
+    the block executes the exact unfused composition below, so CPU
+    outputs are bit-identical to the pre-fusion model. The norm layers
+    carry no parameters, so the param tree is identical on both paths.
+    """
 
     features: int
     num_heads: int
@@ -41,6 +54,7 @@ class DiTBlock(nn.Module):
     norm_epsilon: float = 1e-5
     use_gating: bool = True
     activation: Callable = jax.nn.gelu
+    fused_epilogues: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array, conditioning: jax.Array,
@@ -50,25 +64,42 @@ class DiTBlock(nn.Module):
                           precision=self.precision, name="ada")(conditioning)
         s_mlp, b_mlp, g_mlp, s_attn, b_attn, g_attn = jnp.split(ada, 6, axis=-1)
 
+        # trace-time constant: fused kernels on TPU (or under the
+        # interpret hook), the exact existing XLA composition elsewhere
+        fused = self.fused_epilogues and fused_adaln_active()
+
         ln = lambda name: nn.LayerNorm(
             epsilon=self.norm_epsilon, use_scale=False, use_bias=False,
             dtype=jnp.float32, name=name)
 
-        h = modulate(ln("norm1")(x), s_attn, b_attn)
+        def norm_mod(name, xin, s, b):
+            if fused:
+                return fused_ln_modulate(xin, s, b, self.norm_epsilon)
+            return modulate(ln(name)(xin), s, b)
+
+        h = norm_mod("norm1", x, s_attn, b_attn)
         h = RoPEAttention(
             heads=self.num_heads, dim_head=self.features // self.num_heads,
             backend=self.backend, dtype=self.dtype, precision=self.precision,
             force_fp32_for_softmax=self.force_fp32_for_softmax,
             name="attn")(h, freqs_cis=freqs_cis)
-        x = x + (g_attn * h if self.use_gating else h)
+        if self.use_gating:
+            x = (fused_gate_residual(x, g_attn, h) if fused
+                 else x + g_attn * h)
+        else:
+            x = x + h
 
-        h = modulate(ln("norm2")(x), s_mlp, b_mlp)
+        h = norm_mod("norm2", x, s_mlp, b_mlp)
         h = nn.Dense(self.features * self.mlp_ratio, dtype=self.dtype,
                      precision=self.precision, name="mlp_in")(h)
         h = self.activation(h)
         h = nn.Dense(self.features, dtype=self.dtype,
                      precision=self.precision, name="mlp_out")(h)
-        x = x + (g_mlp * h if self.use_gating else h)
+        if self.use_gating:
+            x = (fused_gate_residual(x, g_mlp, h) if fused
+                 else x + g_mlp * h)
+        else:
+            x = x + h
         return x
 
 
@@ -97,6 +128,7 @@ class SimpleDiT(nn.Module):
     use_hilbert: bool = False
     use_zigzag: bool = False
     activation: Callable = jax.nn.gelu   # MLP nonlinearity inside DiTBlocks
+    fused_epilogues: bool = True         # fused AdaLN/gate kernels on TPU
 
     def setup(self):
         if self.use_hilbert and self.use_zigzag:
@@ -120,6 +152,7 @@ class SimpleDiT(nn.Module):
             dtype=self.dtype, precision=self.precision,
             force_fp32_for_softmax=self.force_fp32_for_softmax,
             norm_epsilon=self.norm_epsilon, activation=self.activation,
+            fused_epilogues=self.fused_epilogues,
             name=f"block_{i}") for i in range(self.num_layers)]
         self.final_norm = nn.LayerNorm(
             epsilon=self.norm_epsilon, dtype=jnp.float32, name="final_norm")

@@ -32,19 +32,22 @@ from .vit_common import PatchEmbedding, RoPEAttention, modulate, rope_frequencie
 class MMAdaLNZero(nn.Module):
     """AdaLN-Zero with SEPARATE zero-init projections for time and text
     conditioning, summed into one 6-param modulation
-    (reference simple_mmdit.py:17-90)."""
+    (reference simple_mmdit.py:17-90).
+
+    With `fused_epilogues` (default) the LayerNorm + both modulated
+    views run as ONE fused Pallas pass on TPU (x read once —
+    ops/fused_adaln.py); off-TPU the exact composition below runs."""
 
     features: int
     dtype: Optional[Dtype] = None
     precision: Optional[jax.lax.Precision] = None
     norm_epsilon: float = 1e-5
     use_mean_pooling: bool = True
+    fused_epilogues: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array, t_emb: jax.Array, text_emb: jax.Array):
-        norm_x = nn.LayerNorm(epsilon=self.norm_epsilon, use_scale=False,
-                              use_bias=False, dtype=jnp.float32,
-                              name="norm")(x)
+        from ..ops.fused_adaln import fused_adaln_active, fused_ln_modulate2
         if t_emb.ndim == 2:
             t_emb = t_emb[:, None, :]
         if text_emb.ndim == 2:
@@ -62,6 +65,13 @@ class MMAdaLNZero(nn.Module):
         s_mlp, b_mlp, g_mlp, s_attn, b_attn, g_attn = jnp.split(params, 6, axis=-1)
         s_mlp = jnp.clip(s_mlp, -10.0, 10.0)
         b_mlp = jnp.clip(b_mlp, -10.0, 10.0)
+        if self.fused_epilogues and fused_adaln_active():
+            x_attn, x_mlp = fused_ln_modulate2(
+                x, s_attn, b_attn, s_mlp, b_mlp, self.norm_epsilon)
+            return x_attn, g_attn, x_mlp, g_mlp
+        norm_x = nn.LayerNorm(epsilon=self.norm_epsilon, use_scale=False,
+                              use_bias=False, dtype=jnp.float32,
+                              name="norm")(x)
         return (modulate(norm_x, s_attn, b_attn), g_attn,
                 modulate(norm_x, s_mlp, b_mlp), g_mlp)
 
@@ -79,26 +89,31 @@ class MMDiTBlock(nn.Module):
     force_fp32_for_softmax: bool = True
     norm_epsilon: float = 1e-5
     activation: Callable = jax.nn.gelu
+    fused_epilogues: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array, t_emb: jax.Array, text_emb: jax.Array,
                  freqs_cis: Optional[Tuple[jax.Array, jax.Array]] = None
                  ) -> jax.Array:
+        from ..ops.fused_adaln import fused_adaln_active, fused_gate_residual
+        fused = self.fused_epilogues and fused_adaln_active()
         x_attn, g_attn, x_mlp, g_mlp = MMAdaLNZero(
             self.features, dtype=self.dtype, precision=self.precision,
-            norm_epsilon=self.norm_epsilon, name="ada")(x, t_emb, text_emb)
+            norm_epsilon=self.norm_epsilon,
+            fused_epilogues=self.fused_epilogues,
+            name="ada")(x, t_emb, text_emb)
         h = RoPEAttention(
             heads=self.num_heads, dim_head=self.features // self.num_heads,
             backend=self.backend, dtype=self.dtype, precision=self.precision,
             force_fp32_for_softmax=self.force_fp32_for_softmax,
             name="attn")(x_attn, freqs_cis=freqs_cis)
-        x = x + g_attn * h
+        x = fused_gate_residual(x, g_attn, h) if fused else x + g_attn * h
         h = nn.Dense(self.features * self.mlp_ratio, dtype=self.dtype,
                      precision=self.precision, name="mlp_in")(x_mlp)
         h = self.activation(h)
         h = nn.Dense(self.features, dtype=self.dtype,
                      precision=self.precision, name="mlp_out")(h)
-        return x + g_mlp * h
+        return fused_gate_residual(x, g_mlp, h) if fused else x + g_mlp * h
 
 
 class SimpleMMDiT(nn.Module):
@@ -120,6 +135,7 @@ class SimpleMMDiT(nn.Module):
     learn_sigma: bool = False
     use_hilbert: bool = False
     activation: Callable = jax.nn.gelu
+    fused_epilogues: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array, temb: jax.Array,
@@ -159,6 +175,7 @@ class SimpleMMDiT(nn.Module):
                 dtype=self.dtype, precision=self.precision,
                 force_fp32_for_softmax=self.force_fp32_for_softmax,
                 norm_epsilon=self.norm_epsilon, activation=self.activation,
+                fused_epilogues=self.fused_epilogues,
                 name=f"block_{i}")(tokens, t_emb, text_emb, freqs)
 
         tokens = nn.LayerNorm(epsilon=self.norm_epsilon, dtype=jnp.float32,
@@ -244,6 +261,7 @@ class HierarchicalMMDiT(nn.Module):
     learn_sigma: bool = False
     use_hilbert: bool = False
     activation: Callable = jax.nn.gelu
+    fused_epilogues: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array, temb: jax.Array,
@@ -307,6 +325,7 @@ class HierarchicalMMDiT(nn.Module):
                     force_fp32_for_softmax=self.force_fp32_for_softmax,
                     norm_epsilon=self.norm_epsilon,
                     activation=self.activation,
+                    fused_epilogues=self.fused_epilogues,
                     name=f"{prefix}_s{stage}_b{i}")(
                     h, t_embs[stage], text_embs[stage], freqs)
             return h

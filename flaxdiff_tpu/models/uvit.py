@@ -156,6 +156,7 @@ class SimpleUDiT(nn.Module):
     norm_epsilon: float = 1e-5
     use_hilbert: bool = False
     use_zigzag: bool = False
+    fused_epilogues: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array, temb: jax.Array,
@@ -186,7 +187,8 @@ class SimpleUDiT(nn.Module):
             mlp_ratio=self.mlp_ratio, backend=self.backend,
             dtype=self.dtype, precision=self.precision,
             force_fp32_for_softmax=self.force_fp32_for_softmax,
-            norm_epsilon=self.norm_epsilon, name=name)
+            norm_epsilon=self.norm_epsilon,
+            fused_epilogues=self.fused_epilogues, name=name)
 
         half = self.num_layers // 2
         skips = []

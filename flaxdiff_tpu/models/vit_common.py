@@ -307,20 +307,32 @@ class AdaLNZero(nn.Module):
     AdaLNParams directly, matching the reference DiT wiring
     (simple_dit.py:42-95); this single-norm variant is the alternative
     conditioning surface the reference also exposes.
+
+    With `fused_epilogues` (default) the LayerNorm + BOTH modulated
+    views run as ONE fused Pallas pass on TPU — x is read once
+    (ops/fused_adaln.py fused_ln_modulate2; clip stays in XLA so its
+    VJP semantics are exact). Off-TPU the exact composition below runs
+    (bit-identical to the pre-fusion model).
     """
 
     features: int
     dtype: Optional[Dtype] = None
     precision: Optional[jax.lax.Precision] = None
     norm_epsilon: float = 1e-5
+    fused_epilogues: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array, conditioning: jax.Array):
+        from ..ops.fused_adaln import fused_adaln_active, fused_ln_modulate2
         params = AdaLNParams(self.features, dtype=self.dtype,
                              precision=self.precision, name="params")(conditioning)
         s_mlp, b_mlp, g_mlp, s_attn, b_attn, g_attn = jnp.split(params, 6, axis=-1)
         s_mlp = jnp.clip(s_mlp, -10.0, 10.0)
         b_mlp = jnp.clip(b_mlp, -10.0, 10.0)
+        if self.fused_epilogues and fused_adaln_active():
+            x_attn, x_mlp = fused_ln_modulate2(
+                x, s_attn, b_attn, s_mlp, b_mlp, self.norm_epsilon)
+            return x_attn, g_attn, x_mlp, g_mlp
         norm_x = nn.LayerNorm(epsilon=self.norm_epsilon, use_scale=False,
                               use_bias=False, dtype=jnp.float32,
                               name="norm")(x)

@@ -113,7 +113,9 @@ def _shard_map_compat(body, mesh, spec):
 
 def _shard_mapped_flash(q: jax.Array, k: jax.Array, v: jax.Array,
                         scale: float, mesh, batch_axes, head_axis,
-                        interpret: bool = False) -> jax.Array:
+                        interpret: bool = False,
+                        block_q: Optional[int] = None,
+                        block_k: Optional[int] = None) -> jax.Array:
     """Run the Pallas kernel per-device under shard_map.
 
     A pallas_call is opaque to GSPMD — under plain jit on a >1-device
@@ -129,13 +131,17 @@ def _shard_mapped_flash(q: jax.Array, k: jax.Array, v: jax.Array,
               else (batch_axes[0] if batch_axes else None))
     spec = jax.sharding.PartitionSpec(b_spec, None, head_axis, None)
     body = lambda a, b, c: flash_attention(a, b, c, scale=scale,
+                                           block_q=block_q,
+                                           block_k=block_k,
                                            interpret=interpret)
     return _shard_map_compat(body, mesh, spec)(q, k, v)
 
 
 def _shard_mapped_flash_bhld(q: jax.Array, k: jax.Array, v: jax.Array,
                              scale: float, mesh, batch_axes, head_axis,
-                             interpret: bool = False) -> jax.Array:
+                             interpret: bool = False,
+                             block_q: Optional[int] = None,
+                             block_k: Optional[int] = None) -> jax.Array:
     """_shard_mapped_flash for [B, H, L, D] operands: batch axes shard
     dim 0, the tensor axis shards heads on dim 1, and each device's
     local [b/dp, h/tp, L, d] shard reshapes FREELY into the kernel's
@@ -153,7 +159,8 @@ def _shard_mapped_flash_bhld(q: jax.Array, k: jax.Array, v: jax.Array,
         bl, hl = ql.shape[0], ql.shape[1]
         flat = lambda t: t.reshape(bl * hl, t.shape[2], t.shape[3])
         out = flash_attention_bh(flat(ql), flat(kl), flat(vl),
-                                 scale=scale, interpret=interpret)
+                                 scale=scale, block_q=block_q,
+                                 block_k=block_k, interpret=interpret)
         return out.reshape(bl, hl, out.shape[1], out.shape[2])
 
     return _shard_map_compat(body, mesh, spec)(q, k, v)
@@ -237,6 +244,11 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         # On a >1-device mesh the kernel must be shard-mapped (GSPMD
         # replicates opaque custom calls); shapes that don't tile the
         # mesh fall back to partitionable XLA attention instead.
+        # per-shape autotuner plan (None fields when inactive/uncached:
+        # dispatch keeps the exact env/default behavior)
+        from . import autotune as _autotune
+        bq, bk, native = _autotune.dispatch_plan(
+            q.shape[1], k.shape[1], d, q.dtype)
         from ..parallel.context import get_active_mesh
         mesh = get_active_mesh()
         if mesh is not None and mesh.devices.size > 1:
@@ -245,14 +257,16 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 return _xla_attention(
                     q, k, v, scale=scale,
                     force_fp32_for_softmax=force_fp32_for_softmax)
-            q, k, v, pad = _maybe_pad_head_dim(q, k, v)
+            q, k, v, pad = _maybe_pad_head_dim(q, k, v, native=native)
             out = _shard_mapped_flash(q, k, v, scale_eff, mesh, *sharded,
-                                      interpret=_flash_interpret())
+                                      interpret=_flash_interpret(),
+                                      block_q=bq, block_k=bk)
             return out[..., :d] if pad else out
         if _route_auto_to_prebuilt(backend):
             return _prebuilt_btnh(q, k, v, scale)
-        q, k, v, pad = _maybe_pad_head_dim(q, k, v)
+        q, k, v, pad = _maybe_pad_head_dim(q, k, v, native=native)
         out = flash_attention(q, k, v, scale=scale_eff,
+                              block_q=bq, block_k=bk,
                               interpret=_flash_interpret())
         return out[..., :d] if pad else out
     if backend == "flash" and not attention_backend_available("flash"):
@@ -325,20 +339,27 @@ def _warn_prebuilt_fallback():
                   stacklevel=3)
 
 
-def _maybe_pad_head_dim(q, k, v):
+def _maybe_pad_head_dim(q, k, v, native=None):
     """Zero-pad head_dim to a 128-lane multiple unless
-    FLAXDIFF_FLASH_NATIVE_D=1 lets the kernel take the true sub-128 dim
-    (Mosaic masks the unused lanes). Padding is exact: padded dims
-    contribute 0 to logits (scale stays 1/sqrt(d_orig)) and 0 to the
-    padded output channels, which the caller slices off. Returns
-    (q, k, v, pad). Shared by BOTH dispatchers so the policy cannot
-    drift between layouts."""
+    FLAXDIFF_FLASH_NATIVE_D=1 — or a per-shape autotuner plan
+    (`native`) — lets the kernel take the true sub-128 dim (Mosaic
+    masks the unused lanes). Padding is exact: padded dims contribute 0
+    to logits (scale stays 1/sqrt(d_orig)) and 0 to the padded output
+    channels, which the caller slices off. Returns (q, k, v, pad).
+    Shared by BOTH dispatchers so the policy cannot drift between
+    layouts. `native=None` keeps the pure env behavior; a plan-derived
+    bool already has the env folded in (env wins inside the autotuner),
+    so it is applied directly."""
     d = q.shape[-1]
     pad = (-d) % 128
     if pad and d % 8 == 0:
-        import os
-        if os.environ.get("FLAXDIFF_FLASH_NATIVE_D") == "1":
-            pad = 0
+        if native is not None:
+            if native:
+                pad = 0
+        else:
+            import os
+            if os.environ.get("FLAXDIFF_FLASH_NATIVE_D") == "1":
+                pad = 0
     if pad:
         widths = ((0, 0),) * (q.ndim - 1) + ((0, pad),)
         q, k, v = (jnp.pad(t, widths) for t in (q, k, v))
@@ -393,10 +414,13 @@ def dot_product_attention_bhld(q: jax.Array, k: jax.Array, v: jax.Array,
             sharded = _flash_specs(mesh, b, h)
             if sharded is not None:
                 scale_eff = scale if scale is not None else 1.0 / (d ** 0.5)
-                q, k, v, pad = _maybe_pad_head_dim(q, k, v)
+                from . import autotune as _autotune
+                bq, bk, native = _autotune.dispatch_plan(
+                    lq, k.shape[2], d, q.dtype)
+                q, k, v, pad = _maybe_pad_head_dim(q, k, v, native=native)
                 out = _shard_mapped_flash_bhld(
                     q, k, v, scale_eff, mesh, *sharded,
-                    interpret=_flash_interpret())
+                    interpret=_flash_interpret(), block_q=bq, block_k=bk)
                 return out[..., :d] if pad else out
         out = dot_product_attention(
             q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
@@ -430,11 +454,14 @@ def dot_product_attention_bhld(q: jax.Array, k: jax.Array, v: jax.Array,
         return _prebuilt_bhld(q, k, v, scale)
 
     from .flash_attention import flash_attention_bh
-    q, k, v, pad = _maybe_pad_head_dim(q, k, v)
+    from . import autotune as _autotune
+    bq, bk, native = _autotune.dispatch_plan(lq, k.shape[2], d, q.dtype)
+    q, k, v, pad = _maybe_pad_head_dim(q, k, v, native=native)
     q3 = q.reshape(b * h, q.shape[2], q.shape[3])
     k3 = k.reshape(b * h, k.shape[2], k.shape[3])
     v3 = v.reshape(b * h, v.shape[2], v.shape[3])
     out = flash_attention_bh(q3, k3, v3, scale=scale_eff,
+                             block_q=bq, block_k=bk,
                              interpret=_flash_interpret())
     out = out.reshape(b, h, lq, out.shape[-1])
     return out[..., :d] if pad else out

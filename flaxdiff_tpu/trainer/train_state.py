@@ -25,6 +25,12 @@ class TrainState(flax.struct.PyTreeNode):
     rng: PRNGKey
     # loss scaling for fp16 (bf16 needs none); static None when disabled
     dynamic_scale: Optional[Any] = None
+    # Device-resident loss ring (TrainerConfig.loss_ring): slot
+    # step % W is written IN-GRAPH by the train step, so the host can
+    # read a whole window of per-step losses with ONE fetch per W steps
+    # — even at log_every=1. None (default) keeps the pytree identical
+    # to pre-ring checkpoints.
+    loss_ring: Optional[jax.Array] = None
     apply_fn: Callable = flax.struct.field(pytree_node=False, default=None)
     tx: optax.GradientTransformation = flax.struct.field(
         pytree_node=False, default=None)
@@ -33,7 +39,8 @@ class TrainState(flax.struct.PyTreeNode):
     def create(cls, apply_fn: Callable, params: PyTree,
                tx: optax.GradientTransformation, rng: PRNGKey,
                ema_decay: Optional[float] = 0.999,
-               dynamic_scale: Optional[Any] = None) -> "TrainState":
+               dynamic_scale: Optional[Any] = None,
+               loss_ring_size: int = 0) -> "TrainState":
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -42,6 +49,8 @@ class TrainState(flax.struct.PyTreeNode):
             if ema_decay is not None else None,
             rng=rng,
             dynamic_scale=dynamic_scale,
+            loss_ring=(jnp.zeros((loss_ring_size,), jnp.float32)
+                       if loss_ring_size > 0 else None),
             apply_fn=apply_fn,
             tx=tx,
         )

@@ -232,6 +232,15 @@ def make_train_step(
             new_state = state.apply_gradients(grads)
 
         new_state = new_state.apply_ema(config.ema_decay)
+        if state.loss_ring is not None:
+            # in-graph loss ring: slot step % W gets this step's RAW
+            # loss (pre-gate — the ring is visibility, not a verdict),
+            # so the host reads a whole window with one fetch per W
+            # steps instead of one per step at log_every=1
+            w = state.loss_ring.shape[0]
+            new_state = new_state.replace(
+                loss_ring=state.loss_ring.at[state.step % w].set(
+                    loss.astype(state.loss_ring.dtype)))
         if numerics is None:
             if gate_nonfinite:
                 new_state = _finite_only_gate(new_state, state)

@@ -59,6 +59,14 @@ def _fetch_losses(arrs):
     return [float(v) for v in jax.device_get(list(arrs))]
 
 
+def _fetch_ring(ring):
+    """The loss-ring variant of the window sync: ONE device_get of the
+    in-graph ring array covers every step in the window (blocks until
+    the newest step settles). Module-level for the same counting-mock
+    contract as _fetch_losses."""
+    return np.asarray(jax.device_get(ring))
+
+
 # a "compile" first step no slower than this multiple of the median
 # steady step did not actually compile (warm persistent cache) and is
 # re-attributed productive — see GoodputLedger.reattribute
@@ -145,6 +153,17 @@ class TrainerConfig:
     # timing"). 1 = exact per-step device timing (the pre-pipelining
     # behavior). Ignored when telemetry is disabled.
     telemetry_sample_every: int = 1
+    # In-graph loss ring (train_step.py / train_state.py): > 0 carries
+    # a device-resident [W] ring in the TrainState that the jitted step
+    # writes at slot step % W. The fit loop then fetches losses ONCE
+    # per W steps — one readback per window even at log_every=1 — and
+    # emits the whole window's per-step losses retroactively
+    # (`window_losses` in the log metrics; recovery checks see every
+    # value, delayed by at most W steps). 0 (default) keeps the
+    # pre-ring behavior AND the pre-ring TrainState pytree — ring
+    # checkpoints carry one extra [W] leaf, so flip it per run, not
+    # mid-run.
+    loss_ring: int = 0
     # In-graph non-finite gate on EVERY step (train_step.py
     # _finite_only_gate): any non-finite element of the updated
     # params/opt-state/EMA keeps its previous value (elementwise — a
@@ -264,7 +283,8 @@ class DiffusionTrainer:
             params = init_fn(init_key)
             return TrainState.create(
                 apply_fn=apply_fn, params=params, tx=tx, rng=train_key,
-                ema_decay=config.ema_decay, dynamic_scale=dynamic_scale)
+                ema_decay=config.ema_decay, dynamic_scale=dynamic_scale,
+                loss_ring_size=max(config.loss_ring, 0))
 
         key = jax.random.PRNGKey(config.seed)
         state_shapes = jax.eval_shape(create_state, key)
@@ -327,6 +347,28 @@ class DiffusionTrainer:
             warnings.warn(f"could not write {path}: {e}; flat-params "
                           "checkpoints need it for inference restore",
                           stacklevel=2)
+
+    # -- flash autotuning ----------------------------------------------------
+    def autotune_flash(self, global_batch: PyTree):
+        """Per-shape flash-attention autotuning (ops/autotune.py): a
+        `jax.eval_shape` scouting pass over the train step records every
+        attention shape the model dispatches (no device work, nothing
+        compiled), then measured probes pick block sizes / native-d per
+        shape and persist them to the active autotuner's cache dir.
+        Returns {shape_key: FlashPlan} for the shapes probed — empty
+        when no autotuner is active (`ops.autotune.activate` /
+        FLAXDIFF_FLASH_TUNE_CACHE) or every shape was already cached
+        (the warm-cache contract: zero probes). Call BEFORE the first
+        train step so the real compile picks the tuned plans up."""
+        from ..ops import autotune as _autotune
+        aut = _autotune.active()
+        if aut is None:
+            return {}
+        from ..parallel.context import use_mesh
+        batch = self._numeric_subtree(global_batch)
+        with use_mesh(self.mesh):
+            jax.eval_shape(self._step, self.state, batch)
+        return aut.probe_pending()
 
     # -- profiling -----------------------------------------------------------
     def step_flops(self, global_batch: PyTree) -> Optional[float]:
@@ -541,6 +583,18 @@ class DiffusionTrainer:
         pending_loss = None
         loss_window: list = []      # (step_no, device scalar), unfetched
         inflight: list = []         # dispatched-step losses, oldest first
+        # In-graph loss ring: the window boundary becomes the ring size
+        # (ONE readback per W steps regardless of log_every); per-step
+        # device scalars are no longer retained host-side. Slot mapping
+        # anchors on the LIVE step counter at fetch time, so resumed
+        # fits and mid-run rollbacks (which rewind the counter) stay
+        # correct without bookkeeping.
+        ring_n = max(cfg.loss_ring, 0)
+        if ring_n and self.state.loss_ring is None:
+            raise ValueError(
+                "TrainerConfig.loss_ring > 0 but the TrainState carries "
+                "no ring (state restored from a pre-ring checkpoint?)")
+        ring_pending = [0]          # count of steps since the last fetch
         peak = device_peak_flops()
         flops = None
         history: Dict[str, Any] = {"steps": [], "loss": [], "imgs_per_sec": [],
@@ -660,6 +714,10 @@ class DiffusionTrainer:
             if hard and cfg.anomaly_action == "rollback":
                 self._recover(flat.get("numerics/loss", float("nan")),
                               step=step_no)
+                # the restore rewound the step counter: unfetched ring
+                # slots no longer map to live steps — drop them (the
+                # rollback event records what happened to the window)
+                ring_pending[0] = 0
 
         # SIGTERM -> finish the current step, checkpoint, return. Only
         # the main thread may install handlers; elsewhere (e.g. fit
@@ -844,7 +902,8 @@ class DiffusionTrainer:
                 monitored = (self._step_monitored is not None
                              and (i + 1) % cfg.numerics_cadence == 0)
                 compile_step = monitored and not monitored_compiled
-                log_step = ((i + 1) % cfg.log_every == 0
+                fetch_every = ring_n if ring_n else cfg.log_every
+                log_step = ((i + 1) % fetch_every == 0
                             or i == total_steps - 1)
                 timer.begin_step(i + 1)
                 if compile_step or log_step:
@@ -865,7 +924,10 @@ class DiffusionTrainer:
                         pending_loss = self.train_step(current)
                 if watchdog is not None and (i == 0 or compile_step):
                     watchdog.resume()
-                loss_window.append((i + 1, pending_loss))
+                if ring_n:
+                    ring_pending[0] += 1
+                else:
+                    loss_window.append((i + 1, pending_loss))
                 inflight.append(pending_loss)
                 if cfg.pipeline_depth > 0:
                     # bounded in-flight dispatch: the device may lag
@@ -908,10 +970,21 @@ class DiffusionTrainer:
                     # newest step settles, so it also closes dispatch —
                     # this step was marked sampled above and the wait
                     # landed in the device phase already).
-                    window = loss_window
-                    loss_window = []
                     inflight.clear()
-                    vals = _fetch_losses([v for _, v in window])
+                    if ring_n:
+                        # one device_get of the in-graph ring covers the
+                        # whole window; the newest r steps wrote slots
+                        # (step_now - r) .. (step_now - 1) mod W
+                        ring_vals = _fetch_ring(self.state.loss_ring)
+                        step_now = int(jax.device_get(self.state.step))
+                        r = min(ring_pending[0], ring_n)
+                        vals = [float(ring_vals[(step_now - r + t) % ring_n])
+                                for t in range(r)]
+                        ring_pending[0] = 0
+                    else:
+                        window = loss_window
+                        loss_window = []
+                        vals = _fetch_losses([v for _, v in window])
                     if nan_pending:
                         vals[-1], nan_pending = float("nan"), False
                     # Mid-window non-finite losses are VISIBILITY, not a
@@ -969,6 +1042,12 @@ class DiffusionTrainer:
                             # window mean beside the spot value
                             metrics["loss_window_mean"] = \
                                 float(np.mean(finite))
+                        if ring_n and len(vals) <= 64:
+                            # retroactive per-step visibility: the
+                            # JsonlLogger serializes small numeric seqs,
+                            # so log_every=1 users still get every
+                            # step's loss — delivered once per window
+                            metrics["window_losses"] = list(vals)
                         if step_mfu is not None:
                             metrics["mfu"] = step_mfu
                         if timed and flops and device_meter.steps:
@@ -1035,6 +1114,7 @@ class DiffusionTrainer:
                             if detector.abnormal_loss(
                                     loss_now, step=i + 1) is not None:
                                 self._recover(loss_now, step=i + 1)
+                                ring_pending[0] = 0   # slots rewound
                                 do_save = False
                         if do_save:
                             with tel.span("ckpt.save_and_commit",

@@ -35,13 +35,16 @@ DEADLINE = float(sys.argv[2]) if len(sys.argv) > 2 else None
 PLAN = [
     ("sweep", 2700),
     ("flashtune", 1500),
+    # fused-epilogue micro win + the native-d re-validation: cheap, and
+    # the r7 kernel work is unmeasured on hardware until these run
+    ("epilogue", 900),
+    ("attnpad", 900),
     ("ablate", 2700),
     ("sweep256", 2700),
     ("ddim", 1500),
     ("longseq", 1200),
     ("ref", 900),
     ("refreal", 900),
-    ("attnpad", 900),
 ]
 
 # stages that run under the measured flashtune-winner env (bench.py

@@ -1,0 +1,236 @@
+"""Fused AdaLN / GEGLU / gate-residual kernels (ops/fused_adaln.py):
+interpret-mode fwd AND bwd numerical parity vs the exact XLA
+compositions, dispatch gating, and model-level bit-identity off-TPU.
+
+Shapes are deliberately tiny — the interpret-mode compile dominates and
+this file must stay a small slice of the tier-1 budget."""
+import os
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flaxdiff_tpu.ops import fused_adaln as fa
+
+EPS = 1e-5
+
+
+def _inputs(key, b=2, l=24, c=16, dtype=jnp.float32):
+    ks = [jax.random.fold_in(key, i) for i in range(8)]
+    x = jax.random.normal(ks[0], (b, l, c), dtype)
+    mods = [jax.random.normal(k, (b, 1, c), dtype) * 0.2
+            for k in ks[1:5]]
+    g = [jax.random.normal(k, (b, l, c), dtype) for k in ks[5:7]]
+    return x, mods, g
+
+
+def _flax_ln(x):
+    return nn.LayerNorm(epsilon=EPS, use_scale=False, use_bias=False,
+                        dtype=jnp.float32).apply({}, x)
+
+
+def test_ln_modulate2_fwd_matches_flax_composition():
+    """Both fused views vs flax LayerNorm + modulate — the exact chain
+    AdaLNZero/MMAdaLNZero run unfused."""
+    x, (s1, b1, s2, b2), _ = _inputs(jax.random.PRNGKey(0))
+    got = fa.fused_ln_modulate2(x, s1, b1, s2, b2, EPS,
+                                interpret=True, force_pallas=True)
+    norm = _flax_ln(x)
+    for view, (s, b) in zip(got, ((s1, b1), (s2, b2))):
+        np.testing.assert_allclose(view, norm * (1 + s) + b,
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_ln_modulate2_grads_match_xla():
+    """dx/ds1/db1/ds2/db2 from the Pallas backward (saved mean/rstd)
+    vs XLA autodiff of the composition."""
+    x, (s1, b1, s2, b2), (g1, g2) = _inputs(jax.random.PRNGKey(1))
+
+    def loss_fused(x, s1, b1, s2, b2):
+        v1, v2 = fa.fused_ln_modulate2(x, s1, b1, s2, b2, EPS,
+                                       interpret=True, force_pallas=True)
+        return jnp.sum(v1 * g1) + jnp.sum(v2 * g2)
+
+    def loss_ref(x, s1, b1, s2, b2):
+        norm = _flax_ln(x)
+        return (jnp.sum((norm * (1 + s1) + b1) * g1)
+                + jnp.sum((norm * (1 + s2) + b2) * g2))
+
+    got = jax.grad(loss_fused, argnums=(0, 1, 2, 3, 4))(x, s1, b1, s2, b2)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4))(x, s1, b1, s2, b2)
+    for name, a, b in zip(("dx", "ds1", "db1", "ds2", "db2"), got, want):
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-3,
+                                   err_msg=name)
+
+
+def test_ln_modulate_single_view_fwd_and_grads():
+    x, (s, b, _, _), (g, _) = _inputs(jax.random.PRNGKey(2))
+    out = fa.fused_ln_modulate(x, s, b, EPS, interpret=True,
+                               force_pallas=True)
+    np.testing.assert_allclose(out, _flax_ln(x) * (1 + s) + b,
+                               rtol=2e-4, atol=2e-4)
+    got = jax.grad(lambda *a: jnp.sum(fa.fused_ln_modulate(
+        *a, EPS, interpret=True, force_pallas=True) * g),
+        argnums=(0, 1, 2))(x, s, b)
+    want = jax.grad(lambda x_, s_, b_: jnp.sum(
+        (_flax_ln(x_) * (1 + s_) + b_) * g), argnums=(0, 1, 2))(x, s, b)
+    for name, a, b_ in zip(("dx", "ds", "db"), got, want):
+        np.testing.assert_allclose(a, b_, rtol=2e-3, atol=2e-3,
+                                   err_msg=name)
+
+
+def test_ln_modulate_multiblock_partial_tail(monkeypatch):
+    """L spanning several row blocks with a padded tail: per-row stats
+    and the backward partial sums must mask/slice it exactly."""
+    monkeypatch.setattr(fa, "_BLOCK_BYTES", 8 * 16 * 4)  # 8-row blocks
+    x, (s, b, _, _), (g, _) = _inputs(jax.random.PRNGKey(3), l=27)
+    out = fa.fused_ln_modulate(x, s, b, EPS, interpret=True,
+                               force_pallas=True)
+    np.testing.assert_allclose(out, _flax_ln(x) * (1 + s) + b,
+                               rtol=2e-4, atol=2e-4)
+    got = jax.grad(lambda x_: jnp.sum(fa.fused_ln_modulate(
+        x_, s, b, EPS, interpret=True, force_pallas=True) * g))(x)
+    want = jax.grad(lambda x_: jnp.sum(
+        (_flax_ln(x_) * (1 + s) + b) * g))(x)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_gate_residual_fwd_and_grads():
+    x, (gate, _, _, _), (g, _) = _inputs(jax.random.PRNGKey(4))
+    h = jax.random.normal(jax.random.PRNGKey(40), x.shape)
+    out = fa.fused_gate_residual(x, gate, h, interpret=True,
+                                 force_pallas=True)
+    np.testing.assert_allclose(out, x + gate * h, rtol=1e-6, atol=1e-6)
+    got = jax.grad(lambda *a: jnp.sum(fa.fused_gate_residual(
+        *a, interpret=True, force_pallas=True) * g),
+        argnums=(0, 1, 2))(x, gate, h)
+    want = jax.grad(lambda x_, g_, h_: jnp.sum((x_ + g_ * h_) * g),
+                    argnums=(0, 1, 2))(x, gate, h)
+    for name, a, b_ in zip(("dx", "dgate", "dh"), got, want):
+        np.testing.assert_allclose(a, b_, rtol=1e-5, atol=1e-5,
+                                   err_msg=name)
+
+
+def test_geglu_fwd_and_grads():
+    proj = jax.random.normal(jax.random.PRNGKey(5), (2, 24, 2 * 16))
+    g = jax.random.normal(jax.random.PRNGKey(50), (2, 24, 16))
+    out = fa.fused_geglu(proj, interpret=True, force_pallas=True)
+    np.testing.assert_allclose(out, fa._xla_geglu(proj),
+                               rtol=1e-5, atol=1e-5)
+    got = jax.grad(lambda p: jnp.sum(fa.fused_geglu(
+        p, interpret=True, force_pallas=True) * g))(proj)
+    want = jax.grad(lambda p: jnp.sum(fa._xla_geglu(p) * g))(proj)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_geglu_matches_geglufeedforward_composition():
+    """The exact GEGLUFeedForward chain: gate is the FIRST half."""
+    proj = jax.random.normal(jax.random.PRNGKey(6), (1, 8, 2 * 8))
+    gate, val = jnp.split(proj, 2, axis=-1)
+    want = val * jax.nn.gelu(gate)
+    got = fa.fused_geglu(proj, interpret=True, force_pallas=True)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_bwd_ab_switch_matches(monkeypatch):
+    """FLAXDIFF_FUSED_ADALN_BWD=xla (recompute-through-autodiff) and the
+    Pallas backward must agree — the in-context A/B is only meaningful
+    if both sides compute the same gradient."""
+    x, (s, b, _, _), (g, _) = _inputs(jax.random.PRNGKey(7))
+
+    def grad_of(x_):
+        return jax.grad(lambda xx: jnp.sum(fa.fused_ln_modulate(
+            xx, s, b, EPS, interpret=True, force_pallas=True) * g))(x_)
+
+    g_pallas = grad_of(x)
+    monkeypatch.setenv("FLAXDIFF_FUSED_ADALN_BWD", "xla")
+    g_xla = grad_of(x)
+    np.testing.assert_allclose(np.asarray(g_pallas), np.asarray(g_xla),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_dispatch_gating(monkeypatch):
+    """Off-TPU default = XLA composition (and fused_adaln_active()
+    False, so model layers take their original code path); =interpret
+    forces the kernels; =xla forces them off even with interpret set
+    elsewhere."""
+    assert not fa.fused_adaln_active()      # CPU test runner
+    monkeypatch.setenv("FLAXDIFF_FUSED_ADALN", "interpret")
+    assert fa.fused_adaln_active()
+    monkeypatch.setenv("FLAXDIFF_FUSED_ADALN", "xla")
+    assert not fa.fused_adaln_active()
+
+
+def test_unsupported_modulator_shapes_fall_back():
+    """Per-token [B, L, C] modulators (3-D conditioning through
+    AdaLNParams) must route to the XLA composition, not the kernel."""
+    x, _, _ = _inputs(jax.random.PRNGKey(8))
+    s = jax.random.normal(jax.random.PRNGKey(80), x.shape) * 0.1
+    b = jnp.zeros_like(s)
+    out = fa.fused_ln_modulate(x, s, b, EPS, interpret=True)
+    np.testing.assert_allclose(out, _flax_ln(x) * (1 + s) + b,
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("model_kind", ["dit", "mmdit"])
+def test_model_interpret_parity_and_cpu_bit_identity(model_kind,
+                                                     monkeypatch):
+    """Model-level acceptance: (a) off-TPU outputs with the flag ON are
+    bit-identical to the flag-OFF (pre-fusion) path — fusion is
+    TPU-only by default; (b) under the interpret hook the fused model
+    matches the unfused one numerically. Params are randomized because
+    the zero-init final projection would otherwise make the comparison
+    vacuous (all-zero outputs)."""
+    from flaxdiff_tpu.models.dit import SimpleDiT
+    from flaxdiff_tpu.models.mmdit import SimpleMMDiT
+
+    kw = dict(patch_size=4, emb_features=32, num_layers=1, num_heads=2)
+    if model_kind == "dit":
+        fused_m, unfused_m = (SimpleDiT(**kw),
+                              SimpleDiT(fused_epilogues=False, **kw))
+    else:
+        fused_m, unfused_m = (SimpleMMDiT(**kw),
+                              SimpleMMDiT(fused_epilogues=False, **kw))
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 3))
+    t = jnp.array([0.3, 0.7])
+    txt = jax.random.normal(jax.random.PRNGKey(1), (2, 5, 12))
+    params = fused_m.init(jax.random.PRNGKey(2), x, t, txt)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    keys = jax.random.split(jax.random.PRNGKey(3), len(leaves))
+    params = jax.tree_util.tree_unflatten(treedef, [
+        jax.random.normal(k, l.shape, l.dtype) * 0.05
+        for l, k in zip(leaves, keys)])
+
+    out_flag_on = fused_m.apply(params, x, t, txt)
+    out_flag_off = unfused_m.apply(params, x, t, txt)
+    assert float(jnp.max(jnp.abs(out_flag_off))) > 1e-4  # not vacuous
+    # (a) same platform, no env: flag on == flag off BIT-IDENTICALLY
+    np.testing.assert_array_equal(np.asarray(out_flag_on),
+                                  np.asarray(out_flag_off))
+    # (b) interpret hook: real kernels, numeric parity
+    monkeypatch.setenv("FLAXDIFF_FUSED_ADALN", "interpret")
+    out_fused = fused_m.apply(params, x, t, txt)
+    np.testing.assert_allclose(np.asarray(out_fused),
+                               np.asarray(out_flag_off),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_bf16_dtype_promotion_matches_composition():
+    """Fused outputs must carry the same dtype the unfused chain
+    produces (f32 norm x bf16 modulators -> f32; bf16 gate residual
+    stays bf16)."""
+    x, (s, b, _, _), _ = _inputs(jax.random.PRNGKey(9),
+                                 dtype=jnp.bfloat16)
+    out = fa.fused_ln_modulate(x, s, b, EPS, interpret=True,
+                               force_pallas=True)
+    ref = _flax_ln(x) * (1 + s) + b
+    assert out.dtype == ref.dtype
+    np.testing.assert_allclose(out.astype(jnp.float32),
+                               ref.astype(jnp.float32),
+                               rtol=3e-2, atol=3e-2)
+    h = jax.random.normal(jax.random.PRNGKey(90), x.shape, jnp.bfloat16)
+    got = fa.fused_gate_residual(x, s, h, interpret=True,
+                                 force_pallas=True)
+    assert got.dtype == (x + s * h).dtype == jnp.bfloat16

@@ -1,0 +1,139 @@
+"""In-graph loss ring (ISSUE 7 satellite, carried over from PR 5): the
+jitted step writes each step's loss into a device-resident TrainState
+ring, and the fit loop reads a whole window with ONE readback per ring
+— decoupling loss visibility from log_every's sync cadence.
+
+Counting mocks over the trainer's sync seams (`_fetch_ring` /
+`_fetch_losses`) assert the readback budget the ring exists to buy."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from flaxdiff_tpu.predictors import EpsilonPredictionTransform
+from flaxdiff_tpu.schedulers import CosineNoiseSchedule
+from flaxdiff_tpu.trainer import DiffusionTrainer, TrainerConfig
+from flaxdiff_tpu.trainer import trainer as trainer_mod
+
+
+def _make_trainer(mesh, **cfg_kw):
+    import flax.linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, t, cond=None):
+            h = nn.Conv(8, (3, 3))(x)
+            return nn.Conv(x.shape[-1], (3, 3))(jnp.tanh(h))
+
+    model = Tiny()
+    return DiffusionTrainer(
+        apply_fn=lambda p, x, t, c: model.apply({"params": p}, x, t),
+        init_fn=lambda k: model.init(k, jnp.zeros((1, 8, 8, 1)),
+                                     jnp.zeros((1,)))["params"],
+        tx=optax.adam(1e-3),
+        schedule=CosineNoiseSchedule(timesteps=100),
+        transform=EpsilonPredictionTransform(), mesh=mesh,
+        config=TrainerConfig(normalize=False, **cfg_kw))
+
+
+def _data(rng, batch=8):
+    while True:
+        yield {"sample": rng.normal(size=(batch, 8, 8, 1))
+               .astype(np.float32)}
+
+
+class _Counting:
+    def __init__(self, real):
+        self.real = real
+        self.calls = 0
+
+    def __call__(self, *a, **k):
+        self.calls += 1
+        return self.real(*a, **k)
+
+
+def test_ring_written_in_graph(mesh, rng):
+    """After N < W steps the ring's first N slots hold each step's loss
+    (raw, pre-gate), written by the jitted step itself."""
+    tr = _make_trainer(mesh, loss_ring=8, log_every=100)
+    data = _data(rng)
+    seen = []
+    for _ in range(3):
+        seen.append(float(jax.device_get(
+            tr.train_step(tr.put_batch(next(data))))))
+    ring = np.asarray(jax.device_get(tr.state.loss_ring))
+    np.testing.assert_allclose(ring[:3], seen, rtol=1e-6)
+    np.testing.assert_array_equal(ring[3:], 0.0)
+
+
+def test_ring_fetch_budget_and_values(mesh, rng):
+    """12 steps with ring W=4: exactly ceil(12/4)=3 ring readbacks,
+    ZERO per-scalar window fetches, and the per-step losses delivered
+    retroactively (`window_losses`) equal a ring-off log_every=1 run's
+    losses step for step (same seed, same data)."""
+    fetch_ring = _Counting(trainer_mod._fetch_ring)
+    fetch_losses = _Counting(trainer_mod._fetch_losses)
+    trainer_mod._fetch_ring = fetch_ring
+    trainer_mod._fetch_losses = fetch_losses
+    try:
+        tr = _make_trainer(mesh, loss_ring=4, log_every=1, seed=7)
+        windows = []
+        tr.fit(_data(np.random.default_rng(0)), total_steps=12,
+               callbacks=[lambda s, l, m: windows.append(
+                   (s, m.get("window_losses")))])
+    finally:
+        trainer_mod._fetch_ring = fetch_ring.real
+        trainer_mod._fetch_losses = fetch_losses.real
+
+    assert fetch_ring.calls == 3
+    assert fetch_losses.calls == 0
+    ring_losses = [v for _, w in windows for v in (w or [])]
+    assert len(ring_losses) == 12
+
+    # reference: identical run, ring off, true per-step fetches
+    tr2 = _make_trainer(mesh, loss_ring=0, log_every=1, seed=7)
+    per_step = []
+    tr2.fit(_data(np.random.default_rng(0)), total_steps=12,
+            callbacks=[lambda s, l, m: per_step.append(l)])
+    np.testing.assert_allclose(ring_losses, per_step, rtol=1e-6)
+
+
+def test_ring_partial_final_window(mesh, rng):
+    """total_steps not a multiple of W: the final fetch returns exactly
+    the leftover steps, mapped to the right slots."""
+    tr = _make_trainer(mesh, loss_ring=4, log_every=1)
+    windows = []
+    tr.fit(_data(rng), total_steps=6,
+           callbacks=[lambda s, l, m: windows.append(
+               (s, list(m.get("window_losses", []))))])
+    assert [s for s, _ in windows] == [4, 6]
+    assert [len(w) for _, w in windows] == [4, 2]
+    for _, w in windows:
+        assert all(np.isfinite(v) for v in w)
+
+
+def test_ring_survives_resumed_step_counter(mesh, rng):
+    """Slot mapping anchors on the live step counter: a fit starting
+    from a nonzero step (resume) still reads the right slots."""
+    tr = _make_trainer(mesh, loss_ring=4, log_every=1)
+    tr.fit(_data(rng), total_steps=3)       # step counter now 3
+    windows = []
+    tr.fit(_data(rng), total_steps=5,
+           callbacks=[lambda s, l, m: windows.append(
+               list(m.get("window_losses", [])))])
+    got = [v for w in windows for v in w]
+    assert len(got) == 5 and all(np.isfinite(v) for v in got)
+
+
+def test_pre_ring_state_pytree_unchanged(mesh):
+    """loss_ring=0 (default) keeps the TrainState structure leaf-for-
+    leaf identical to the pre-ring code — existing checkpoints restore
+    unchanged."""
+    tr = _make_trainer(mesh)
+    assert tr.state.loss_ring is None
+    tr_ring = _make_trainer(mesh, loss_ring=8)
+    assert tr_ring.state.loss_ring.shape == (8,)
+    n_plain = len(jax.tree_util.tree_leaves(tr.state))
+    n_ring = len(jax.tree_util.tree_leaves(tr_ring.state))
+    assert n_ring == n_plain + 1

@@ -99,6 +99,48 @@ def test_flash_attention_native_head_dim_hw_lanes(monkeypatch):
         np.testing.assert_allclose(a, b_, rtol=2e-3, atol=2e-3, err_msg=name)
 
 
+@pytest.mark.parametrize("d,lq,lk,dtype,bq,bk", [
+    # sublane-minimum head dim, default sequence-capped blocks
+    (8, 256, 256, "float32", None, None),
+    # the flagship native shape (d=64) as CROSS-attention with a masked
+    # kv tail, bf16 — the exact dtype the bench's attnpad stage times
+    (64, 256, 77, "bfloat16", None, None),
+    # d=64 self-attention at the DEFAULT 512x1024 blocks the r3 attnpad
+    # failure ran with (multi-block q at a padded tail)
+    (64, 300, 300, "float32", 128, 256),
+])
+def test_flash_attention_native_d_matrix(monkeypatch, d, lq, lk, dtype,
+                                         bq, bk):
+    """Native sub-128 head dims across the configs attnpad/flashtune
+    will run on hardware, under the FORCED 128-lane scratch layout
+    (ops/flash_attention.py _FORCE_LANES — the layout where the r3
+    `(128, 64) x (128, 0)` _bcast bug lived). Guards the fix so the
+    next TPU window can finally record flash_native_d64_ms."""
+    from flaxdiff_tpu.ops import flash_attention as fa
+    monkeypatch.setattr(fa, "_FORCE_LANES", fa.LANES)
+    jdt = jnp.dtype(dtype)
+    key = jax.random.PRNGKey(13)
+    q = jax.random.normal(key, (1, lq, 2, d), jdt)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (1, lk, 2, d), jdt)
+    v = jax.random.normal(jax.random.fold_in(key, 2), (1, lk, 2, d), jdt)
+    g = jax.random.normal(jax.random.fold_in(key, 3), (1, lq, 2, d), jdt)
+
+    flash = lambda q_, k_, v_: flash_attention(q_, k_, v_, None, bq, bk,
+                                               True)
+    tol = 6e-2 if jdt == jnp.bfloat16 else 5e-3
+    got = flash(q, k, v).astype(jnp.float32)
+    want = _xla_attention(q, k, v).astype(jnp.float32)
+    np.testing.assert_allclose(got, want, rtol=tol, atol=tol)
+    gf32 = g.astype(jnp.float32)
+    dq = jax.grad(lambda q_: jnp.sum(
+        flash(q_, k, v).astype(jnp.float32) * gf32))(q)
+    dq_ref = jax.grad(lambda q_: jnp.sum(
+        _xla_attention(q_, k, v).astype(jnp.float32) * gf32))(q)
+    np.testing.assert_allclose(dq.astype(jnp.float32),
+                               dq_ref.astype(jnp.float32),
+                               rtol=tol * 4, atol=tol * 4)
+
+
 @pytest.mark.parametrize("apply_silu", [True, False])
 def test_fused_groupnorm_silu_matches_xla(apply_silu):
     key = jax.random.PRNGKey(0)

@@ -20,6 +20,13 @@ from flaxdiff_tpu.ops.attention import (_xla_attention_bhld,
                                         dot_product_attention_bhld)
 from flaxdiff_tpu.ops.prebuilt_flash import prebuilt_flash_attention_bhld
 
+# this jax may predate the global interpret hook the kernel-running
+# tests depend on — skip those honestly instead of erroring (the
+# dispatch-routing tests that never execute the kernel still run)
+needs_interpret_hook = pytest.mark.skipif(
+    not hasattr(pltpu, "force_tpu_interpret_mode"),
+    reason="pltpu.force_tpu_interpret_mode unavailable on this jax")
+
 
 @pytest.fixture(autouse=True)
 def _small_blocks(monkeypatch):
@@ -33,6 +40,7 @@ def _rand(shape, key, dtype=jnp.float32):
 
 
 @pytest.mark.parametrize("lq,lk", [(256, 256), (256, 77), (200, 256)])
+@needs_interpret_hook
 def test_prebuilt_wrapper_matches_xla(lq, lk):
     b, h, d = 2, 2, 64
     q = _rand((b, h, lq, d), 0)
@@ -45,6 +53,7 @@ def test_prebuilt_wrapper_matches_xla(lq, lk):
                                atol=2e-3, rtol=2e-3)
 
 
+@needs_interpret_hook
 def test_prebuilt_wrapper_grads_match_xla():
     b, h, lq, lk, d = 1, 2, 128, 77, 64
     q = _rand((b, h, lq, d), 3)
@@ -97,6 +106,7 @@ def test_auto_impl_env_does_not_break_cpu():
         os.environ.pop("FLAXDIFF_FLASH_IMPL", None)
 
 
+@needs_interpret_hook
 def test_prebuilt_wrapper_block_clamp_and_bf16(monkeypatch):
     """Blocks larger than the padded sequence must clamp (env asks for
     512x1024 against a 128-token sequence) and bf16 operands must run
@@ -113,6 +123,7 @@ def test_prebuilt_wrapper_block_clamp_and_bf16(monkeypatch):
                                np.asarray(ref), atol=2e-2, rtol=2e-2)
 
 
+@needs_interpret_hook
 def test_prebuilt_dispatch_pads_odd_head_dim():
     """head_dim not a sublane multiple (e.g. 20) is padded to the next
     multiple of 8 by _prebuilt_bhld and sliced back — exactness comes

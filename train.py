@@ -138,6 +138,25 @@ def parse_args(argv=None):
                         "the live state is finite by construction); "
                         "disabling restores the legacy synchronous "
                         "save-cadence loss check")
+    p.add_argument("--flash_tune_cache", default=None,
+                   help="per-shape flash-attention autotuner cache dir "
+                        "(ops/autotune.py): before the first step, a "
+                        "shape-scouting eval_shape pass + measured "
+                        "probes pick block sizes and the native-d "
+                        "choice per attention shape and persist them "
+                        "here; a warm cache re-measures nothing. "
+                        "FLAXDIFF_FLASH_BLOCK_Q/K / _NATIVE_D env "
+                        "overrides always win over cached plans")
+    p.add_argument("--loss_ring", type=int, default=0,
+                   help="device-resident in-graph loss ring of this "
+                        "many slots: the jitted step records each "
+                        "step's loss on device and the fit loop "
+                        "fetches the whole window with ONE readback "
+                        "per ring, so even log_every=1 costs one sync "
+                        "per window (per-step losses arrive "
+                        "retroactively as window_losses). 0 disables; "
+                        "changes the checkpointed state tree by one "
+                        "[N] leaf, so pick per run")
     p.add_argument("--compilation_cache_dir", default=None,
                    help="persistent XLA compilation cache directory: "
                         "relaunches (and coordinated restarts) reload "
@@ -239,6 +258,9 @@ def main(argv=None):
     apply_jax_platforms_env()
     if args.compilation_cache_dir:
         configure_compilation_cache(args.compilation_cache_dir)
+    if args.flash_tune_cache:
+        from flaxdiff_tpu.ops import autotune as _flash_autotune
+        _flash_autotune.activate(args.flash_tune_cache)
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -575,7 +597,8 @@ def main(argv=None):
                              pipeline_depth=args.pipeline_depth,
                              telemetry_sample_every=(
                                  args.telemetry_sample_every),
-                             gate_nonfinite=not args.no_nonfinite_gate),
+                             gate_nonfinite=not args.no_nonfinite_gate,
+                             loss_ring=args.loss_ring),
         policy=policy, null_cond=null_cond, checkpointer=ckpt,
         autoencoder=autoencoder, telemetry=telemetry)
 
@@ -658,6 +681,17 @@ def main(argv=None):
     # scripts/bench_text_encode.py; SURVEY §7.3(4)).
     from flaxdiff_tpu.data.prefetch import prefetch_map
     it = prefetch_map(encode_text, raw_iter, depth=2)
+    if args.flash_tune_cache:
+        # shape-scouting + measured probes BEFORE the first compile, so
+        # the train step picks the tuned per-shape plans up; the peeked
+        # batch is chained back so no data is dropped
+        import itertools as _it
+        first = next(it)
+        plans = trainer.autotune_flash(trainer.put_batch(first))
+        if plans:
+            print(f"flash autotuner probed {len(plans)} shape(s) -> "
+                  f"{args.flash_tune_cache}")
+        it = _it.chain([first], it)
     done = 0
     while done < args.total_steps:
         chunk = min(args.val_every or args.total_steps,
