@@ -1395,21 +1395,130 @@ def stage_longseq(args) -> dict:
     return res
 
 
+def stage_serve(args) -> dict:
+    """Serving-layer SLO bench: a seeded Poisson arrival process
+    replayed against the batched sampler scheduler
+    (flaxdiff_tpu/serving/, docs/SERVING.md) over a deliberately tiny
+    pipeline — the number measures scheduler mechanics (grouping,
+    bucketing, program-cache reuse, continuous admission, completion
+    sync policy), not model compute, the same philosophy as the
+    dispatch stage.
+
+    Reports p50/p99 latency, throughput, batch occupancy, shed count,
+    and program-cache hit rate for a COLD replay (compiles on the
+    request path, the worst case) and a WARM replay of the identical
+    workload — whose `re_traces` must be 0: repeat traffic through the
+    compiled-program cache never re-traces (the ISSUE-8 acceptance
+    bar, asserted in tests/test_serving.py as well)."""
+    _apply_jax_platforms()
+    import jax
+    import jax.numpy as jnp
+
+    from flaxdiff_tpu.inference import (DiffusionInferencePipeline,
+                                        build_model)
+    from flaxdiff_tpu.serving import (PoissonWorkloadSpec,
+                                      SchedulerConfig, ServingScheduler,
+                                      build_workload, replay)
+    from flaxdiff_tpu.telemetry import Telemetry
+
+    cpu = jax.devices()[0].platform == "cpu"
+    n = 24 if (cpu or args.quick) else 96
+    rate_hz = 4.0 if cpu else 16.0
+
+    config = {
+        "model": {"name": "simple_dit", "emb_features": 32,
+                  "num_heads": 4, "num_layers": 1, "patch_size": 4,
+                  "output_channels": 1},
+        "schedule": {"name": "cosine", "timesteps": 100},
+        "predictor": "epsilon",
+    }
+    model = build_model("simple_dit", emb_features=32, num_heads=4,
+                        num_layers=1, patch_size=4, output_channels=1)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 1)),
+                        jnp.zeros((1,)), None)
+    pipe = DiffusionInferencePipeline.from_config(config, params=params)
+
+    # two NFEs x two samplers: four program families, NFE-heterogeneous
+    # within each sampler group (continuous-admission masking at work)
+    base = {"resolution": 8, "channels": 1, "use_ema": False,
+            "deadline_s": 120.0}
+    spec = PoissonWorkloadSpec(
+        n_requests=n, rate_hz=rate_hz, seed=1234,
+        mix=[{**base, "diffusion_steps": 4, "sampler": "ddim"},
+             {**base, "diffusion_steps": 8, "sampler": "ddim"},
+             {**base, "diffusion_steps": 4, "sampler": "euler_ancestral"},
+             {**base, "diffusion_steps": 8,
+              "sampler": "euler_ancestral"}])
+    workload = build_workload(spec)
+
+    tel = Telemetry(enabled=False)
+    sched = ServingScheduler(
+        pipeline=pipe,
+        config=SchedulerConfig(round_steps=4, batch_buckets=(1, 2, 4),
+                               max_inflight=2),
+        telemetry=tel)
+
+    def counters():
+        snap = tel.registry.snapshot()
+        return {k: snap.get(k, 0.0) for k in (
+            "serving/program_cache_hits", "serving/program_cache_misses",
+            "serving/shed", "serving/rows_real", "serving/rows_padded",
+            "serving/backpressure_waits")}
+
+    res = {"platform": jax.devices()[0].platform, "n_requests": n,
+           "rate_hz": rate_hz, "rounds_per_request": None}
+    try:
+        for phase in ("cold", "warm"):
+            before = counters()
+            summary = replay(sched, workload,
+                             timeout_s=600 if cpu else 120)
+            after = counters()
+            delta = {k: after[k] - before[k] for k in after}
+            occ_total = delta["serving/rows_real"] \
+                + delta["serving/rows_padded"]
+            summary["batch_occupancy"] = round(
+                delta["serving/rows_real"] / occ_total, 3) \
+                if occ_total else None
+            lookups = delta["serving/program_cache_hits"] \
+                + delta["serving/program_cache_misses"]
+            summary["cache_hit_rate"] = round(
+                delta["serving/program_cache_hits"] / lookups, 3) \
+                if lookups else None
+            summary["re_traces"] = delta["serving/program_cache_misses"]
+            summary["shed_total"] = delta["serving/shed"]
+            summary["backpressure_waits"] = delta[
+                "serving/backpressure_waits"]
+            res[phase] = summary
+            log(f"serve {phase}: p50={summary['latency_ms']['p50']} "
+                f"p99={summary['latency_ms']['p99']} ms, "
+                f"{summary['throughput_rps']} req/s, "
+                f"occ={summary['batch_occupancy']}, "
+                f"hit_rate={summary['cache_hit_rate']}, "
+                f"re_traces={summary['re_traces']}, "
+                f"shed={summary['shed_total']}")
+    finally:
+        sched.close()
+    res["warm_retrace_free"] = bool(
+        res.get("warm", {}).get("re_traces", 1) == 0)
+    return res
+
+
 STAGES = {"flashtune": stage_flashtune, "sweep": stage_sweep,
           "sweep256": stage_sweep256, "ref": stage_ref,
           "refreal": stage_refreal,
           "ddim": stage_ddim, "attnpad": stage_attnpad,
           "ablate": stage_ablate, "longseq": stage_longseq,
-          "dispatch": stage_dispatch, "epilogue": stage_epilogue}
+          "dispatch": stage_dispatch, "epilogue": stage_epilogue,
+          "serve": stage_serve}
 
 # info-value order (VERDICT r3 next #1): the headline sweep first, its
 # baseline second; refreal anchors vs_reference_binary; dispatch is the
 # r5 step-loop-overhead evidence (cheap — tiny model); flashtune is
 # cheap and unblocks the tuned micros; ddim is the BASELINE.md
 # inference target; the rest are diagnostics.
-STAGE_ORDER = ("sweep", "ref", "refreal", "dispatch", "flashtune",
-               "ddim", "attnpad", "epilogue", "ablate", "sweep256",
-               "longseq")
+STAGE_ORDER = ("sweep", "ref", "refreal", "dispatch", "serve",
+               "flashtune", "ddim", "attnpad", "epilogue", "ablate",
+               "sweep256", "longseq")
 
 # rough healthy-tunnel cost estimates (seconds) for budget scheduling —
 # a stage is skipped when the remaining budget can't cover its MINIMUM
@@ -1427,7 +1536,11 @@ STAGE_EST = {"sweep": 900, "ref": 450, "refreal": 700, "flashtune": 500,
              "longseq": 550,   # + r5 on-chip 16k correctness cell
              # 9 tiny-model fit cells (3 depths x 3 telemetry modes),
              # each ~steps x a-few-ms + one tiny-model compile
-             "dispatch": 240}
+             "dispatch": 240,
+             # cold + warm Poisson replay on a tiny pipeline: arrival
+             # clock ~n/rate s each + a handful of small jit compiles
+             # on the cold pass
+             "serve": 240}
 
 # stages that receive the flashtune winner env. Headline stages
 # (sweep/ref/ddim/sweep256) run with code defaults: an unvalidated

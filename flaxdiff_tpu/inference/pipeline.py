@@ -26,9 +26,28 @@ CONFIG_FILENAME = "pipeline_config.json"
 from ..trainer.optim import TEMPLATE_FILENAME  # noqa: E402
 
 
+def _sampler_cache_key(sampler_obj: Sampler, guidance_scale: float) -> Tuple:
+    """Cache key carrying the sampler's full config, not just its
+    class: `DDIMSampler(eta=0.0)` and `DDIMSampler(eta=1.0)` are
+    different samplers and must not share a compiled DiffusionSampler.
+    Fields are flax.struct dataclass fields; unhashable values (arrays)
+    degrade to repr — stable enough for identity, never a collision
+    back to class-only."""
+    import dataclasses as _dc
+    cfg = []
+    for f in _dc.fields(sampler_obj):
+        v = getattr(sampler_obj, f.name)
+        try:
+            hash(v)
+        except TypeError:
+            v = repr(v)
+        cfg.append((f.name, v))
+    return (type(sampler_obj), tuple(cfg), float(guidance_scale))
+
+
 class DiffusionInferencePipeline:
     """Holds model + params + diffusion math; caches one DiffusionSampler
-    per (sampler class, guidance scale) pair (reference
+    per (sampler class + config, guidance scale) tuple (reference
     pipeline.py:176-215)."""
 
     def __init__(self, model, params: Dict[str, Any],
@@ -45,7 +64,7 @@ class DiffusionInferencePipeline:
         self.input_config = input_config
         self.autoencoder = autoencoder
         self.config = config or {}
-        self._sampler_cache: Dict[Tuple[type, float], DiffusionSampler] = {}
+        self._sampler_cache: Dict[Tuple, DiffusionSampler] = {}
 
     # -- construction --------------------------------------------------------
     @staticmethod
@@ -185,7 +204,7 @@ class DiffusionInferencePipeline:
             sampler_obj = sampler()
         else:
             sampler_obj = sampler
-        key = (type(sampler_obj), float(guidance_scale))
+        key = _sampler_cache_key(sampler_obj, guidance_scale)
         if key not in self._sampler_cache:
             self._sampler_cache[key] = DiffusionSampler(
                 model_fn=lambda p, x, t, c: self.model.apply(p, x, t, c),
@@ -236,6 +255,8 @@ class DiffusionInferencePipeline:
         tel = global_telemetry()
         sampler_name = (sampler if isinstance(sampler, str)
                         else type(ds.sampler).__name__)
+        import time as _time
+        t0 = _time.perf_counter()
         with tel.span("sampler.generate", cat="inference",
                       args={"sampler": sampler_name,
                             "diffusion_steps": diffusion_steps,
@@ -251,6 +272,13 @@ class DiffusionInferencePipeline:
                 inpaint_mask=inpaint_mask)
             # the scan dispatches async; close the span on real work
             out = jax.block_until_ready(out)
+        # solo inference measured with the serving layer's metric
+        # family (docs/OBSERVABILITY.md): one observation per call,
+        # compile included — this is the end-to-end client latency
+        from ..serving.scheduler import MS_BUCKET_BOUNDS
+        tel.histogram("inference/generate_ms",
+                      bounds=MS_BUCKET_BOUNDS).observe(
+            (_time.perf_counter() - t0) * 1e3)
         tel.counter("inference/samples_generated").inc(num_samples)
         return np.asarray(jax.device_get(out))
 

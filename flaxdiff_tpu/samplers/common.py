@@ -303,3 +303,93 @@ class DiffusionSampler:
 
     # Reference alias (samplers/common.py:433)
     generate_images = generate_samples
+
+    # -- serving programs ----------------------------------------------------
+    # Builders for the serving layer's continuous-batching rounds
+    # (flaxdiff_tpu/serving/engine.py). Both are UNCACHED — the serving
+    # engine owns the compiled-program cache and its hit/miss counters;
+    # a second cache here would hide misses from the SLO metrics.
+    #
+    # Row model: the batch axis is REQUESTS, each row a block of
+    # `block_shape` samples (the request's own num_samples). Everything
+    # per-row — trajectory position, remaining NFE, timestep pairs, RNG
+    # — is vmapped, so one program serves rows at different points of
+    # different-length trajectories. vmap (not reshape-to-one-batch) is
+    # what keeps per-row RNG exact: stochastic samplers draw
+    # `normal(key, x.shape)` per row with the row's own key, the same
+    # call a solo `generate_samples` makes, so a batched request is
+    # bit-identical to its solo run (tested in tests/test_serving.py).
+
+    def make_chunk_program(self, round_steps: int):
+        """One continuous-batching round: advance every row by up to
+        `round_steps` of ITS OWN trajectory.
+
+        program(params, x, keys, pairs, n_act, offsets, cond, uncond)
+          x        [R, *block]            row carries (trajectory state)
+          keys     [R, 2] uint32          per-row scan RNG carries
+          pairs    [R, round_steps, 2]    this round's (t_cur, t_next)
+                                          pairs, inert-padded past n_act
+          n_act    [R] int32              live steps this round (0 for
+                                          padding rows: carry unchanged)
+          offsets  [R] int32              global step index of the row's
+                                          first step this round (multistep
+                                          samplers key history on it)
+          state    [R, ...] pytree        per-row sampler state carry
+                                          (init_state at admission)
+        Returns (x, keys, state) carries. Rows never interact, so a
+        padded round is output-invariant for the real rows.
+        """
+        def program(params, x, keys, pairs, n_act, offsets, cond, uncond,
+                    state):
+            def row(x_r, key, row_pairs, n, off, c, u, st):
+                denoise = self._denoise_fn(params, c, u)
+
+                def scan_step(carry, inp):
+                    x_c, rng, s = carry
+                    pair, i = inp
+                    rng, sub = jax.random.split(rng)
+                    x_n, s_n = self.sampler.step(
+                        denoise, x_c, pair[0], pair[1], sub, s,
+                        self.schedule, off + i)
+                    active = i < n
+                    x_n = jnp.where(active, x_n, x_c)
+                    s_n = jax.tree_util.tree_map(
+                        lambda a, b: jnp.where(active, a, b), s_n, s)
+                    return (x_n, rng, s_n), ()
+
+                (x_out, rng_out, s_out), _ = jax.lax.scan(
+                    scan_step, (x_r, key, st),
+                    (row_pairs, jnp.arange(round_steps)))
+                return x_out, rng_out, s_out
+
+            return jax.vmap(row)(x, keys, pairs, n_act, offsets,
+                                 cond, uncond, state)
+
+        return jax.jit(program)
+
+    def make_terminal_program(self):
+        """Terminal denoise for rows whose trajectory just completed:
+        the solo program's final `denoise(x, steps[-1])` call, vmapped
+        with each row's OWN terminal step value (spacings of different
+        NFE need not end at bit-identical values)."""
+        def program(params, x, t_term, cond, uncond):
+            def row(x_r, t_r, c, u):
+                denoise = self._denoise_fn(params, c, u)
+                x0, _ = denoise(x_r, jnp.full((x_r.shape[0],), t_r))
+                return x0
+
+            return jax.vmap(row)(x, t_term, cond, uncond)
+
+        return jax.jit(program)
+
+    def trajectory_inputs(self, num_steps: int,
+                          start: Optional[float] = None,
+                          end: float = 0.0):
+        """Host-side per-request trajectory constants for the serving
+        programs: ([num_steps, 2] step pairs, terminal step value) —
+        the same spacing the solo program closes over."""
+        steps = get_timestep_spacing(self.timestep_spacing, num_steps,
+                                     self.schedule.timesteps, start, end,
+                                     schedule=self.schedule)
+        pairs = jnp.stack([steps[:-1], steps[1:]], axis=1)
+        return pairs, steps[-1]

@@ -1,0 +1,297 @@
+"""Compiled-program engine for the serving scheduler.
+
+Owns the **compiled-program cache**: jitted wrappers around the
+existing single-`lax.scan` `DiffusionSampler`, keyed on
+
+    (kind, batch_bucket, resolution, sequence_length, scan_steps,
+     sampler, guidance, use_ema, num_samples, channels,
+     has_cond, has_uncond)
+
+so repeat traffic never re-traces. `scan_steps` is the program's scan
+trip count — the whole (bucketed) NFE in run-to-completion mode, the
+round length in continuous mode; either way NFE-heterogeneous rows
+share one program because each row's timestep pairs and live-step
+count are *inputs*, not trace constants. Cache hits/misses are counted
+at `serving/program_cache_hits` / `serving/program_cache_misses`.
+
+Batching model (see `DiffusionSampler.make_chunk_program`): the batch
+axis is requests, each row an independent block of the request's
+`num_samples` samples with its own RNG carry. Rows never interact, so
+grouping, padding to a batch bucket, and chunked rounds are all
+output-invariant: a batched request is bit-identical to the same
+request run solo through `DiffusionInferencePipeline.generate_samples`
+(tested).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import RngSeq, clip_images
+from .request import SampleRequest, ServingFuture
+
+# batch buckets the scheduler pads micro-batches up to; the largest is
+# also the admission cap per round
+DEFAULT_BATCH_BUCKETS: Tuple[int, ...] = (1, 2, 4, 8)
+
+
+def bucket_up(n: int, buckets: Tuple[int, ...]) -> int:
+    """Smallest bucket >= n (the scheduler never builds a group larger
+    than max(buckets))."""
+    for b in sorted(buckets):
+        if b >= n:
+            return b
+    return max(buckets)
+
+
+def nfe_bucket(n: int) -> int:
+    """Next power of two >= n: the run-to-completion scan length, so
+    nearby NFEs share one program (rows mask their own tail)."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+class RequestState:
+    """One admitted request's device-resident trajectory carry."""
+
+    __slots__ = ("req", "future", "submit_t", "admit_t", "group",
+                 "x", "rng", "state", "pairs", "terminal_t", "nfe",
+                 "done", "cond", "uncond", "compile_ms", "rounds",
+                 "first_dispatch_t")
+
+    def __init__(self, req: SampleRequest, future: ServingFuture,
+                 submit_t: float, admit_t: float, group: tuple,
+                 x, rng, state, pairs, terminal_t: float,
+                 cond, uncond):
+        self.req = req
+        self.future = future
+        self.submit_t = submit_t
+        self.admit_t = admit_t
+        self.group = group
+        self.x = x                  # [num_samples, *sample_shape]
+        self.rng = rng              # scan RNG carry (loop key lineage)
+        self.state = state          # sampler state pytree
+        self.pairs = pairs          # [nfe, 2] full trajectory pairs
+        self.terminal_t = terminal_t
+        self.nfe = int(req.diffusion_steps)
+        self.done = 0               # completed trajectory steps
+        self.cond = cond
+        self.uncond = uncond
+        self.compile_ms = 0.0
+        self.rounds = 0
+        self.first_dispatch_t: Optional[float] = None
+
+    @property
+    def remaining(self) -> int:
+        return self.nfe - self.done
+
+
+class SamplerProgramEngine:
+    """Prepares request carries and advances them in batched rounds
+    over a `DiffusionInferencePipeline`."""
+
+    def __init__(self, pipeline, telemetry=None):
+        self.pipeline = pipeline
+        if telemetry is None:
+            from ..telemetry import global_telemetry
+            telemetry = global_telemetry()
+        self.telemetry = telemetry
+        self._programs: Dict[tuple, Any] = {}
+
+    # -- keys -----------------------------------------------------------------
+    def group_key(self, req: SampleRequest) -> tuple:
+        """Compatibility key: requests sharing it may ride one round.
+        NFE is deliberately absent — rows mask their own trajectory
+        length, so short requests don't queue behind long ones."""
+        use_ema = bool(req.use_ema
+                       and self.pipeline.ema_params is not None)
+        ic = self.pipeline.input_config
+        conditional = bool(ic is not None and ic.conditions)
+        has_cond = bool(req.prompts is not None
+                        or req.conditioning is not None or conditional)
+        # CFG pairs a null embedding with the prompt — mirror
+        # generate_samples: uncond exists only on the prompted path
+        has_uncond = bool((req.prompts is not None
+                           or req.conditioning is not None)
+                          and conditional)
+        return (int(req.resolution), req.sequence_length,
+                int(req.channels), int(req.num_samples),
+                str(req.sampler), float(req.guidance_scale),
+                use_ema, has_cond, has_uncond)
+
+    def _program_key(self, kind: str, group: tuple, bucket: int,
+                     scan_steps: int) -> tuple:
+        return (kind, int(bucket), int(scan_steps)) + group
+
+    def _get_program(self, kind: str, group: tuple, bucket: int,
+                     scan_steps: int, build) -> Tuple[Any, bool]:
+        key = self._program_key(kind, group, bucket, scan_steps)
+        prog = self._programs.get(key)
+        if prog is not None:
+            self.telemetry.counter("serving/program_cache_hits").inc()
+            return prog, False
+        self.telemetry.counter("serving/program_cache_misses").inc()
+        prog = build()
+        self._programs[key] = prog
+        return prog, True
+
+    @property
+    def program_cache_size(self) -> int:
+        return len(self._programs)
+
+    # -- request admission ----------------------------------------------------
+    def _sampler_for(self, req: SampleRequest):
+        return self.pipeline.get_sampler(req.sampler, req.guidance_scale)
+
+    def _params_for(self, group: tuple):
+        use_ema = group[6]
+        return (self.pipeline.ema_params
+                if use_ema else self.pipeline.params)
+
+    def prepare(self, req: SampleRequest, future: ServingFuture,
+                submit_t: float, admit_t: float) -> RequestState:
+        """Build the device-resident carry for one request — the exact
+        state a solo `generate_samples` call reaches right before its
+        scan, so the batched trajectory continues bit-identically."""
+        pipe = self.pipeline
+        k = req.num_samples
+        cond = uncond = None
+        if req.conditioning is not None:
+            cond = jnp.asarray(req.conditioning)
+            if pipe.input_config is not None and pipe.input_config.conditions:
+                uncond = pipe.input_config.get_unconditionals(
+                    batch_size=k)[0]
+        elif req.prompts is not None:
+            if pipe.input_config is None or not pipe.input_config.conditions:
+                raise ValueError("pipeline has no conditioning inputs")
+            c = pipe.input_config.conditions[0]
+            cond = jnp.asarray(c.encoder(list(req.prompts)))
+            uncond = pipe.input_config.get_unconditionals(batch_size=k)[0]
+        elif pipe.input_config is not None and pipe.input_config.conditions:
+            # prompt-less conditional checkpoint: the cached null
+            # tokens, exactly as generate_samples feeds them
+            cond = pipe.input_config.get_unconditionals(batch_size=k)[0]
+
+        ds = self._sampler_for(req)
+        rngstate = RngSeq.create(req.seed)
+        rngstate, noise_key = rngstate.next_key()
+        rngstate, loop_key = rngstate.next_key()
+
+        resolution, channels = int(req.resolution), int(req.channels)
+        if ds.autoencoder is not None:
+            resolution = resolution // ds.autoencoder.downscale_factor
+            channels = ds.autoencoder.latent_channels
+        if req.sequence_length is not None:
+            shape = (k, req.sequence_length, resolution, resolution,
+                     channels)
+        else:
+            shape = (k, resolution, resolution, channels)
+
+        x = jax.random.normal(noise_key, shape) * ds.schedule.max_noise_std()
+        pairs, terminal_t = ds.trajectory_inputs(int(req.diffusion_steps))
+        state = ds.sampler.init_state(x)
+        return RequestState(
+            req=req, future=future, submit_t=submit_t, admit_t=admit_t,
+            group=self.group_key(req), x=x, rng=loop_key, state=state,
+            pairs=pairs, terminal_t=float(terminal_t), cond=cond,
+            uncond=uncond)
+
+    # -- batched rounds -------------------------------------------------------
+    def _stack_rows(self, rows: List[RequestState], bucket: int):
+        """Stack per-row carries, replicating row 0 into padding slots
+        (inert: n_act = 0 keeps their carry unchanged, and their output
+        is discarded)."""
+        pad = bucket - len(rows)
+        srcs = rows + [rows[0]] * pad
+
+        def stack(get):
+            return jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *[get(r) for r in srcs])
+
+        x = stack(lambda r: r.x)
+        keys = stack(lambda r: r.rng)
+        state = stack(lambda r: r.state)
+        group = rows[0].group
+        cond = stack(lambda r: r.cond) if group[7] else None
+        uncond = stack(lambda r: r.uncond) if group[8] else None
+        return x, keys, state, cond, uncond
+
+    def advance(self, rows: List[RequestState], bucket: int,
+                round_steps: int) -> Tuple[List[RequestState], float]:
+        """Run one round: every row advances min(remaining, round_steps)
+        steps of its own trajectory. Returns (rows that completed their
+        trajectory this round, compile seconds spent — 0 on a cache
+        hit)."""
+        group = rows[0].group
+        ds = self._sampler_for(rows[0].req)
+        x, keys, state, cond, uncond = self._stack_rows(rows, bucket)
+
+        pad = bucket - len(rows)
+        chunk_pairs, n_act, offsets = [], [], []
+        for r in rows + [rows[0]] * pad:
+            live = max(0, min(r.remaining, round_steps))
+            sl = r.pairs[r.done:r.done + round_steps]
+            if sl.shape[0] == 0:        # exhausted padding row
+                sl = jnp.broadcast_to(r.pairs[-1:], (round_steps, 2))
+            elif sl.shape[0] < round_steps:
+                sl = jnp.concatenate(
+                    [sl, jnp.broadcast_to(
+                        sl[-1:], (round_steps - sl.shape[0], 2))], axis=0)
+            chunk_pairs.append(sl)
+            n_act.append(live)
+            offsets.append(r.done)
+        pairs = jnp.stack(chunk_pairs)
+        n_act_a = jnp.asarray(n_act, jnp.int32)
+        offsets_a = jnp.asarray(offsets, jnp.int32)
+
+        program, miss = self._get_program(
+            "chunk", group, bucket, round_steps,
+            lambda: ds.make_chunk_program(round_steps))
+        t0 = time.perf_counter()
+        x_n, keys_n, state_n = program(
+            self._params_for(group), x, keys, pairs, n_act_a, offsets_a,
+            cond, uncond, state)
+        compile_s = (time.perf_counter() - t0) if miss else 0.0
+
+        finished: List[RequestState] = []
+        for i, r in enumerate(rows):
+            r.x = x_n[i]
+            r.rng = keys_n[i]
+            r.state = jax.tree_util.tree_map(lambda a: a[i], state_n)
+            r.done += int(n_act[i])
+            r.rounds += 1
+            r.compile_ms += compile_s * 1e3
+            if r.remaining <= 0:
+                finished.append(r)
+        return finished, compile_s
+
+    def finalize(self, rows: List[RequestState],
+                 bucket: int) -> Tuple[jax.Array, float]:
+        """Terminal denoise + (optional) decode + clip for completed
+        rows. Returns ([R, num_samples, *sample_shape] device array in
+        row order, compile seconds)."""
+        group = rows[0].group
+        ds = self._sampler_for(rows[0].req)
+        x, _, _, cond, uncond = self._stack_rows(rows, bucket)
+        pad = bucket - len(rows)
+        t_term = jnp.asarray(
+            [r.terminal_t for r in rows + [rows[0]] * pad], jnp.float32)
+
+        program, miss = self._get_program(
+            "terminal", group, bucket, 0,
+            lambda: ds.make_terminal_program())
+        t0 = time.perf_counter()
+        x0 = program(self._params_for(group), x, t_term, cond, uncond)
+        compile_s = (time.perf_counter() - t0) if miss else 0.0
+
+        x0 = x0[:len(rows)]
+        if ds.autoencoder is not None:
+            flat = x0.reshape((-1,) + x0.shape[2:])
+            flat = ds.autoencoder.decode(flat)
+            x0 = flat.reshape(x0.shape[:2] + flat.shape[1:])
+        return clip_images(x0), compile_s

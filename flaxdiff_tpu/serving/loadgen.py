@@ -1,0 +1,96 @@
+"""Seeded Poisson load generation + replay against the scheduler.
+
+One seeded `numpy` Generator drives everything — inter-arrival gaps
+(exponential), template choice, and per-request seeds — so a spec
+builds the *identical* workload every time: the `bench.py serve` stage
+replays the same list twice to prove the warm program cache re-traces
+nothing, and tests assert replay determinism outright.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .request import DeadlineExceeded, SampleRequest, SampleResult
+
+
+@dataclasses.dataclass
+class PoissonWorkloadSpec:
+    """`n_requests` arrivals at `rate_hz` (exponential gaps), each
+    request drawn from `mix` (SampleRequest kwargs templates) with a
+    per-request seed — all from one seeded generator."""
+    n_requests: int = 32
+    rate_hz: float = 4.0
+    seed: int = 0
+    mix: Sequence[Dict[str, Any]] = (
+        {"resolution": 64, "diffusion_steps": 16, "sampler": "ddim"},)
+
+
+def build_workload(spec: PoissonWorkloadSpec
+                   ) -> List[Tuple[float, SampleRequest]]:
+    """[(arrival_offset_s, request)] — deterministic in `spec`."""
+    rng = np.random.default_rng(spec.seed)
+    out: List[Tuple[float, SampleRequest]] = []
+    t = 0.0
+    for _ in range(spec.n_requests):
+        t += float(rng.exponential(1.0 / spec.rate_hz))
+        template = dict(spec.mix[int(rng.integers(len(spec.mix)))])
+        template.setdefault("seed", int(rng.integers(2 ** 31)))
+        out.append((t, SampleRequest(**template)))
+    return out
+
+
+def _pct(xs: List[float], q: float) -> Optional[float]:
+    return float(np.percentile(np.asarray(xs), q)) if xs else None
+
+
+def replay(scheduler, workload: List[Tuple[float, SampleRequest]],
+           speed: float = 1.0, timeout_s: float = 300.0) -> Dict[str, Any]:
+    """Submit the workload on its arrival clock (scaled by `speed`),
+    wait for every future, and summarize SLO stats. Shed requests
+    (deadline / overload) are counted, not errors."""
+    t0 = time.perf_counter()
+    futures = []
+    for offset, req in workload:
+        delay = offset / speed - (time.perf_counter() - t0)
+        if delay > 0:
+            time.sleep(delay)
+        futures.append(scheduler.submit(req))
+    results: List[SampleResult] = []
+    shed = errors = 0
+    for fut in futures:
+        try:
+            results.append(fut.result(timeout=timeout_s))
+        except DeadlineExceeded:
+            shed += 1
+        except Exception:
+            errors += 1
+    wall = time.perf_counter() - t0
+
+    lat = [r.latency_ms for r in results]
+    samples = sum(int(np.asarray(r.samples).shape[0]) for r in results)
+    return {
+        "requests": len(workload),
+        "completed": len(results),
+        "shed": shed,
+        "errors": errors,
+        "wall_s": round(wall, 3),
+        "throughput_rps": round(len(results) / wall, 3) if wall else None,
+        "samples_per_s": round(samples / wall, 3) if wall else None,
+        "latency_ms": {
+            "p50": _pct(lat, 50), "p99": _pct(lat, 99),
+            "mean": float(np.mean(lat)) if lat else None,
+            "max": max(lat) if lat else None,
+        },
+        "queue_ms_mean": float(np.mean([r.queue_ms for r in results]))
+        if results else None,
+        "compile_ms_mean": float(np.mean([r.compile_ms for r in results]))
+        if results else None,
+        "device_ms_mean": float(np.mean([r.device_ms for r in results]))
+        if results else None,
+        "rounds_mean": float(np.mean([r.rounds for r in results]))
+        if results else None,
+    }

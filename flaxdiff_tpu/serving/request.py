@@ -1,0 +1,116 @@
+"""Serving request/result types and the thread-safe result future.
+
+A `SampleRequest` is one unit of admission: a block of `num_samples`
+samples sharing one prompt list, seed, sampler, and NFE budget. The
+scheduler batches COMPATIBLE requests (same shape/sampler/guidance
+family — see `serving.engine.group_key`) into micro-batch rounds; NFE
+may differ within a group because the engine masks each row to its own
+trajectory length.
+
+Determinism contract: a request's samples depend only on its own
+fields (seed included) — never on what it was batched with, padded to,
+or preempted by. `tests/test_serving.py` holds the scheduler to
+bit-identity against a solo `DiffusionInferencePipeline.generate_samples`
+call with the same arguments.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class DeadlineExceeded(Exception):
+    """The request was shed before compute: its deadline had already
+    passed when the dispatch loop reached it."""
+
+
+class SchedulerClosed(Exception):
+    """Submitted after close(), or cancelled by a non-draining close."""
+
+
+@dataclasses.dataclass
+class SampleRequest:
+    """One serving request: `num_samples` samples from one seed.
+
+    `prompts` (optional) must have length `num_samples` when given —
+    the same coupling `generate_samples` has. `conditioning` bypasses
+    the encoder with a pre-encoded array. `deadline_s` is a relative
+    latency budget from submit time; a request that is still queued
+    when it expires is shed before any compute is spent on it.
+    """
+    num_samples: int = 1
+    resolution: int = 64
+    diffusion_steps: int = 50           # NFE
+    sampler: str = "ddim"
+    guidance_scale: float = 0.0
+    seed: int = 42
+    prompts: Optional[List[str]] = None
+    conditioning: Optional[Any] = None
+    sequence_length: Optional[int] = None
+    channels: int = 3
+    use_ema: bool = True
+    deadline_s: Optional[float] = None
+
+    def __post_init__(self):
+        if self.diffusion_steps < 1:
+            raise ValueError("diffusion_steps must be >= 1")
+        if self.prompts is not None:
+            self.num_samples = len(self.prompts)
+        if self.num_samples < 1:
+            raise ValueError("num_samples must be >= 1")
+
+
+@dataclasses.dataclass
+class SampleResult:
+    """Samples plus the request's latency decomposition (milliseconds).
+
+    queue_ms   submit -> first dispatch
+    compile_ms program trace+compile stalls in rounds this request
+               rode (0 on a warm program cache)
+    device_ms  residual: latency - queue - compile — dispatch plus
+               device execution of every round to result readiness
+    latency_ms submit -> samples ready on host
+    rounds     scheduler rounds the request participated in
+    """
+    samples: np.ndarray
+    request: SampleRequest
+    queue_ms: float = 0.0
+    compile_ms: float = 0.0
+    device_ms: float = 0.0
+    latency_ms: float = 0.0
+    rounds: int = 0
+
+    def timings(self) -> Dict[str, float]:
+        return {"queue_ms": self.queue_ms, "compile_ms": self.compile_ms,
+                "device_ms": self.device_ms, "latency_ms": self.latency_ms}
+
+
+class ServingFuture:
+    """Minimal thread-safe future for one request's result."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result: Optional[SampleResult] = None
+        self._exception: Optional[BaseException] = None
+
+    def set_result(self, result: SampleResult) -> None:
+        self._result = result
+        self._event.set()
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._exception = exc
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> SampleResult:
+        if not self._event.wait(timeout):
+            raise TimeoutError("serving result not ready")
+        if self._exception is not None:
+            raise self._exception
+        assert self._result is not None
+        return self._result

@@ -1,0 +1,345 @@
+"""Batched sampler scheduler: thread-safe admission, micro-batch
+rounds with continuous admission, bounded in-flight dispatch, deadline
+shedding, and per-request SLO telemetry.
+
+Architecture (docs/SERVING.md):
+
+- **submit()** enqueues a `SampleRequest` and returns a `ServingFuture`
+  immediately. Overload is shed at the door (`max_queue`), deadlines
+  are shed at dispatch time — both *before* any compute is spent,
+  counted at `serving/shed`.
+- A single **dispatch loop** drains the queue in rounds. Each round
+  serves one compatibility group (least-recently-served for fairness),
+  admits queued requests into the group's free capacity, pads the
+  batch to a bucket, and advances every row by up to
+  `round_steps` of its OWN trajectory through the engine's compiled
+  program. Rows that complete exit mid-group ("continuous admission"):
+  a 10-NFE request batched with a 50-NFE one returns after its own
+  rounds, and its slot is refilled from the queue.
+- Completed rows are handed (still device-resident, dispatch still
+  async) to a **completion thread** that performs the only host syncs
+  — `_block_until_ready` + `_device_get`, module-level seams so tests
+  can count them, the PR-5 sync-free-loop convention. The dispatch
+  loop keeps at most `max_inflight` completed batches in flight;
+  beyond that it waits (genuine backpressure, counted at
+  `serving/backpressure_waits`) instead of racing the device.
+- **close(drain=True)** stops admission, finishes queued + active
+  work, and joins both threads.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+from .engine import (DEFAULT_BATCH_BUCKETS, RequestState,
+                     SamplerProgramEngine, bucket_up, nfe_bucket)
+from .request import (DeadlineExceeded, SampleRequest, SampleResult,
+                      SchedulerClosed, ServingFuture)
+
+# Millisecond-scale SLO latency buckets (the registry default bounds
+# are seconds-scale training phases).
+MS_BUCKET_BOUNDS: Tuple[float, ...] = (
+    1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0, 30000.0, 60000.0, 120000.0,
+    300000.0)
+
+
+# The scheduler's host-sync + clock primitives, module-level so tests
+# can monkeypatch counting wrappers (the PR-5 seam convention): the
+# dispatch loop itself must never block on device work.
+
+def _block_until_ready(x) -> None:
+    import jax
+    jax.block_until_ready(x)
+
+
+def _device_get(x):
+    import jax
+    import numpy as np
+    return np.asarray(jax.device_get(x))
+
+
+def _now() -> float:
+    return time.perf_counter()
+
+
+@dataclasses.dataclass
+class SchedulerConfig:
+    """Knobs for the dispatch loop.
+
+    round_steps: trajectory steps advanced per round (the compiled
+      program's scan length). 0 = run-to-completion: one round runs a
+      group's whole (power-of-two-bucketed) max NFE — lowest overhead,
+      but a short request then waits for the longest row in its round.
+    batch_buckets: padded batch sizes; max(batch_buckets) caps rows
+      per round.
+    max_queue: admission cap; submits past it are shed at the door.
+    max_inflight: completed batches allowed in flight to the
+      completion thread before the dispatch loop backpressures.
+    """
+    round_steps: int = 8
+    batch_buckets: Tuple[int, ...] = DEFAULT_BATCH_BUCKETS
+    max_queue: int = 256
+    max_inflight: int = 2
+    drain_timeout_s: float = 120.0
+
+
+class ServingScheduler:
+    """Thread-safe request scheduler over a `SamplerProgramEngine`.
+
+    Pass `autostart=False` to submit requests before the first round
+    (tests use this to pin grouping deterministically), then `start()`.
+    """
+
+    def __init__(self, pipeline=None, engine=None,
+                 config: Optional[SchedulerConfig] = None,
+                 telemetry=None, autostart: bool = True):
+        if engine is None:
+            if pipeline is None:
+                raise ValueError("need a pipeline or an engine")
+            engine = SamplerProgramEngine(pipeline, telemetry=telemetry)
+        if telemetry is None:
+            from ..telemetry import global_telemetry
+            telemetry = global_telemetry()
+        self.engine = engine
+        self.config = config or SchedulerConfig()
+        self.telemetry = telemetry
+
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: Deque[Tuple[SampleRequest, ServingFuture, float]] \
+            = deque()
+        self._active: Dict[tuple, List[RequestState]] = {}
+        self._completions: Deque[Tuple[List[RequestState], object, float]] \
+            = deque()
+        self._last_served: Dict[tuple, int] = {}
+        self._round_no = 0
+        self._closed = False
+        self._draining = False
+        self._dispatch_done = False
+
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="serving-dispatch",
+            daemon=True)
+        self._completer = threading.Thread(
+            target=self._completion_loop, name="serving-complete",
+            daemon=True)
+        self._started = False
+        if autostart:
+            self.start()
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "ServingScheduler":
+        if not self._started:
+            self._started = True
+            self._dispatcher.start()
+            self._completer.start()
+        return self
+
+    def __enter__(self) -> "ServingScheduler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close(drain=exc == (None, None, None))
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Stop admission; with drain, finish queued + active work
+        first. Idempotent."""
+        timeout = self.config.drain_timeout_s if timeout is None else timeout
+        with self._cv:
+            self._closed = True
+            self._draining = drain
+            if not drain or not self._started:
+                # nothing will ever drain an unstarted scheduler —
+                # resolve pending futures instead of leaving waiters
+                # hanging
+                for _, fut, _ in self._queue:
+                    fut.set_exception(SchedulerClosed("scheduler closed"))
+                self._queue.clear()
+                for rows in self._active.values():
+                    for r in rows:
+                        r.future.set_exception(
+                            SchedulerClosed("scheduler closed"))
+                self._active.clear()
+            self._cv.notify_all()
+        if self._started:
+            self._dispatcher.join(timeout)
+        with self._cv:
+            self._dispatch_done = True
+            self._cv.notify_all()
+        if self._started:
+            self._completer.join(timeout)
+
+    # -- admission ------------------------------------------------------------
+    def submit(self, req: SampleRequest) -> ServingFuture:
+        """Enqueue one request. Never blocks: overload and post-close
+        submits come back as exceptions on the returned future."""
+        fut = ServingFuture()
+        tel = self.telemetry
+        with self._cv:
+            if self._closed:
+                fut.set_exception(SchedulerClosed("scheduler closed"))
+                return fut
+            tel.counter("serving/requests_in").inc()
+            if len(self._queue) >= self.config.max_queue:
+                tel.counter("serving/shed").inc()
+                fut.set_exception(DeadlineExceeded(
+                    f"queue full ({self.config.max_queue})"))
+                return fut
+            self._queue.append((req, fut, _now()))
+            tel.gauge("serving/queue_depth").set(len(self._queue))
+            self._cv.notify_all()
+        return fut
+
+    # -- dispatch loop --------------------------------------------------------
+    def _shed_expired_locked(self) -> None:
+        """Drop queued requests whose deadline already passed — before
+        any compute is spent on them (held lock)."""
+        if not self._queue:
+            return
+        now = _now()
+        kept: Deque = deque()
+        for req, fut, t_sub in self._queue:
+            if req.deadline_s is not None and now - t_sub > req.deadline_s:
+                self.telemetry.counter("serving/shed").inc()
+                fut.set_exception(DeadlineExceeded(
+                    f"deadline {req.deadline_s}s passed while queued"))
+            else:
+                kept.append((req, fut, t_sub))
+        self._queue = kept
+        self.telemetry.gauge("serving/queue_depth").set(len(self._queue))
+
+    def _pick_group_locked(self) -> Optional[tuple]:
+        """Least-recently-served group among those with work (active
+        rows or queued requests), queue order breaking ties."""
+        candidates: List[tuple] = list(self._active.keys())
+        for req, _, _ in self._queue:
+            gk = self.engine.group_key(req)
+            if gk not in candidates:
+                candidates.append(gk)
+        if not candidates:
+            return None
+        return min(candidates,
+                   key=lambda g: self._last_served.get(g, -1))
+
+    def _admit_locked(self, gk: tuple, capacity: int,
+                      now: float) -> List[RequestState]:
+        """Pop up to `capacity` queued requests of group `gk` (FIFO) and
+        prepare their device carries."""
+        admitted: List[RequestState] = []
+        kept: Deque = deque()
+        for req, fut, t_sub in self._queue:
+            if len(admitted) < capacity \
+                    and self.engine.group_key(req) == gk:
+                try:
+                    admitted.append(self.engine.prepare(
+                        req, fut, t_sub, now))
+                except Exception as e:  # bad request, not a loop error
+                    fut.set_exception(e)
+            else:
+                kept.append((req, fut, t_sub))
+        self._queue = kept
+        self.telemetry.gauge("serving/queue_depth").set(len(self._queue))
+        return admitted
+
+    def _dispatch_loop(self) -> None:
+        tel = self.telemetry
+        cfg = self.config
+        max_bucket = max(cfg.batch_buckets)
+        while True:
+            with self._cv:
+                while not (self._queue or self._active or self._closed):
+                    self._cv.wait()
+                if self._closed and not self._draining:
+                    break
+                self._shed_expired_locked()
+                gk = self._pick_group_locked()
+                if gk is None:
+                    if self._closed:
+                        break
+                    continue
+                rows = self._active.pop(gk, [])
+                now = _now()
+                rows += self._admit_locked(gk, max_bucket - len(rows), now)
+                if not rows:
+                    continue
+                self._round_no += 1
+                self._last_served[gk] = self._round_no
+
+            bucket = bucket_up(len(rows), cfg.batch_buckets)
+            round_steps = cfg.round_steps or nfe_bucket(
+                max(r.remaining for r in rows))
+            tel.gauge("serving/batch_occupancy").set(len(rows) / bucket)
+            tel.counter("serving/rows_real").inc(len(rows))
+            tel.counter("serving/rows_padded").inc(bucket - len(rows))
+            tel.counter("serving/rounds").inc()
+            t_disp = _now()
+            for r in rows:
+                if r.first_dispatch_t is None:
+                    r.first_dispatch_t = t_disp
+
+            finished, _ = self.engine.advance(rows, bucket, round_steps)
+            live = [r for r in rows if r.remaining > 0]
+            if finished:
+                out, _ = self.engine.finalize(
+                    finished, bucket_up(len(finished), cfg.batch_buckets))
+            with self._cv:
+                if live:
+                    self._active.setdefault(gk, []).extend(live)
+                if finished:
+                    self._completions.append((finished, out, _now()))
+                    self._cv.notify_all()
+                    # PR-5 bounded in-flight dispatch: never race more
+                    # than max_inflight completed batches ahead of the
+                    # completion thread's host sync
+                    while len(self._completions) > cfg.max_inflight:
+                        tel.counter("serving/backpressure_waits").inc()
+                        self._cv.wait()
+        # non-draining close: rows popped mid-round missed close()'s
+        # cancel sweep — resolve their futures before exiting
+        with self._cv:
+            for rows in self._active.values():
+                for r in rows:
+                    r.future.set_exception(
+                        SchedulerClosed("scheduler closed"))
+            self._active.clear()
+            for _, fut, _ in self._queue:
+                fut.set_exception(SchedulerClosed("scheduler closed"))
+            self._queue.clear()
+
+    # -- completion loop ------------------------------------------------------
+    def _completion_loop(self) -> None:
+        tel = self.telemetry
+
+        def hist(name: str):
+            return tel.histogram(name, bounds=MS_BUCKET_BOUNDS)
+
+        while True:
+            with self._cv:
+                while not self._completions and not self._dispatch_done:
+                    self._cv.wait()
+                if not self._completions and self._dispatch_done:
+                    break
+                rows, out, _t_disp = self._completions.popleft()
+                self._cv.notify_all()     # free a backpressure slot
+            _block_until_ready(out)
+            host = _device_get(out)
+            t_ready = _now()
+            for i, r in enumerate(rows):
+                latency_ms = (t_ready - r.submit_t) * 1e3
+                queue_ms = ((r.first_dispatch_t or r.submit_t)
+                            - r.submit_t) * 1e3
+                device_ms = max(0.0, latency_ms - queue_ms - r.compile_ms)
+                hist("serving/latency_ms").observe(latency_ms)
+                hist("serving/queue_ms").observe(queue_ms)
+                hist("serving/compile_ms").observe(r.compile_ms)
+                hist("serving/device_ms").observe(device_ms)
+                tel.counter("serving/requests_ok").inc()
+                r.future.set_result(SampleResult(
+                    samples=host[i], request=r.req, queue_ms=queue_ms,
+                    compile_ms=r.compile_ms, device_ms=device_ms,
+                    latency_ms=latency_ms, rounds=r.rounds))

@@ -49,12 +49,19 @@ class Telemetry:
                  recorder: Optional[TraceRecorder] = None,
                  goodput: Optional[GoodputLedger] = None,
                  aggregator: Optional[CrossHostAggregator] = None,
-                 enabled: Optional[bool] = None):
+                 enabled: Optional[bool] = None,
+                 epoch: Optional[int] = None):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.exporters = list(exporters)
         self.recorder = recorder
         self.goodput = goodput if goodput is not None else GoodputLedger()
         self.aggregator = aggregator
+        # every raw JSONL row is stamped with this epoch (the
+        # pod-agreed job incarnation — see set_epoch); defaults to the
+        # local goodput incarnation so even a solo host's rows are
+        # distinguishable across restarts
+        self.epoch = int(epoch) if epoch is not None \
+            else int(self.goodput.incarnation)
         # enabled gates the COSTLY instrumentation (per-step device sync,
         # per-step JSONL rows); cheap counters/spans run regardless
         self.enabled = bool(enabled) if enabled is not None \
@@ -122,10 +129,22 @@ class Telemetry:
         if self.recorder is not None:
             self.recorder.instant(name, cat=cat, args=args)
 
+    def set_epoch(self, epoch: int) -> None:
+        """Adopt the pod-agreed epoch (train.py calls this with the
+        `agree_epoch` result). Every subsequent raw row carries it, so
+        two drivers of the SAME incarnation that drifted apart — a
+        stale process still writing after a coordinated restart voted a
+        new epoch — are distinguishable row by row, not just file by
+        file (the PR-3 carried-over follow-up)."""
+        self.epoch = int(epoch)
+
     # -- export --------------------------------------------------------------
     def write_record(self, record: Dict[str, object]) -> None:
         """One raw typed record into the JSONL stream (a no-op on the
-        disabled hub, which has no exporters)."""
+        disabled hub, which has no exporters), stamped with the current
+        epoch tag unless the caller already set one."""
+        if "epoch" not in record:
+            record = {**record, "epoch": self.epoch}
         for ex in self.exporters:
             ex.write(record)
 
@@ -154,11 +173,13 @@ class Telemetry:
 
     def export(self, step: Optional[int] = None,
                extra: Optional[Dict[str, float]] = None) -> None:
-        """Registry + goodput snapshot through every exporter."""
+        """Registry + goodput snapshot through every exporter, epoch-
+        stamped like the raw rows (snapshots bypass write_record)."""
         snap = self.registry.snapshot()
         snap.update(self.goodput.snapshot())
         if extra:
             snap.update(extra)
+        snap.setdefault("epoch", float(self.epoch))
         for ex in self.exporters:
             ex.export(snap, step=step)
 

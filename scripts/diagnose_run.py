@@ -84,7 +84,8 @@ def phase_section(steps: List[Dict], lines: List[str]) -> None:
         lines.append("")
         return
     names = sorted({k for r in steps for k in r
-                    if k not in ("type", "step", "_time", "wall")})
+                    if k not in ("type", "step", "_time", "wall",
+                                 "epoch")})
     walls = [float(r.get("wall", 0.0)) for r in steps]
     wall_total = sum(walls)
     lines.append(f"{'phase':<12s} {'total s':>10s} {'% wall':>8s} "
@@ -186,6 +187,50 @@ def health_section(numerics: List[Dict], anomalies: List[Dict],
     lines.append("")
 
 
+def serving_section(metrics: List[Dict], lines: List[str]) -> None:
+    """SLO summary from the last snapshot's serving/* series
+    (docs/SERVING.md): request accounting, latency decomposition,
+    occupancy, and program-cache health."""
+    if not metrics:
+        return
+    last = metrics[-1]
+    if not any(k.startswith("serving/") for k in last):
+        return
+    lines.append("== Serving (last snapshot) ==")
+
+    def g(name: str, default=0.0):
+        v = last.get(name, default)
+        return float(v) if isinstance(v, (int, float)) else default
+
+    req_in, ok, shed = (g("serving/requests_in"), g("serving/requests_ok"),
+                        g("serving/shed"))
+    lines.append(f"requests in/ok/shed: {req_in:.0f} / {ok:.0f} "
+                 f"/ {shed:.0f}"
+                 + (f"  (shed {shed / req_in:.1%})" if req_in else ""))
+    hits, misses = (g("serving/program_cache_hits"),
+                    g("serving/program_cache_misses"))
+    if hits + misses:
+        lines.append(f"program cache:      {hits:.0f} hits / "
+                     f"{misses:.0f} misses "
+                     f"(hit rate {hits / (hits + misses):.1%})")
+    real, padded = g("serving/rows_real"), g("serving/rows_padded")
+    if real + padded:
+        lines.append(f"batch occupancy:    "
+                     f"{real / (real + padded):.1%} over "
+                     f"{g('serving/rounds'):.0f} rounds "
+                     f"(backpressure waits "
+                     f"{g('serving/backpressure_waits'):.0f})")
+    for h in ("latency", "queue", "compile", "device"):
+        cnt = g(f"serving/{h}_ms/count")
+        if cnt:
+            lines.append(
+                f"{h + '_ms':<19s} p50 "
+                f"{g(f'serving/{h}_ms/p50'):>9.2f}   p99 "
+                f"{g(f'serving/{h}_ms/p99'):>9.2f}   max "
+                f"{g(f'serving/{h}_ms/max'):>9.2f}   n {cnt:.0f}")
+    lines.append("")
+
+
 def counters_section(metrics: List[Dict], lines: List[str]) -> None:
     if not metrics:
         return
@@ -193,7 +238,8 @@ def counters_section(metrics: List[Dict], lines: List[str]) -> None:
     interesting = {k: v for k, v in last.items()
                    if isinstance(v, (int, float))
                    and (k.startswith(("data/", "telemetry/", "resilience/",
-                                      "inference/", "numerics/", "memory/"))
+                                      "inference/", "numerics/", "memory/",
+                                      "serving/"))
                         or k.startswith("goodput/"))}
     if not interesting:
         return
@@ -282,6 +328,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     phase_section(steps, lines)
     health_section(numerics, anomalies, provenance, metrics, lines)
     pod_section(pods, lines)
+    serving_section(metrics, lines)
     counters_section(metrics, lines)
     trace_path = os.path.join(directory, "trace.json")
     if os.path.exists(trace_path):

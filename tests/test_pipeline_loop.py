@@ -133,8 +133,10 @@ def test_offsample_steps_add_no_syncs(mesh, rng, tmp_path, monkeypatch):
     assert sorted(int(r["step"]) for r in steps) == [1, 4, 8]
     assert all("device" in r for r in steps)
     for r in steps:
+        # "epoch" is the row's incarnation tag (PR 8), not a phase
         parts = sum(v for k, v in r.items()
-                    if k not in ("type", "step", "wall", "_time"))
+                    if k not in ("type", "step", "wall", "_time",
+                                 "epoch"))
         assert parts == pytest.approx(r["wall"], rel=1e-3, abs=1e-5)
     # the three windows tile the run: window walls sum to ~the 8 steps'
     # total wall-clock (no step's time is dropped from the rows)

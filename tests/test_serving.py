@@ -1,0 +1,464 @@
+"""Serving subsystem tests (flaxdiff_tpu/serving/, docs/SERVING.md).
+
+Scheduler mechanics run against a jax-free FakeEngine (fast,
+deterministic); the host-sync contract is enforced with counting mocks
+on the module-level seams (the PR-5 convention); the acceptance bars —
+batched == solo bit-identity under padding/masking/chunking, and a
+warm program cache that never re-traces — run against a real tiny
+pipeline.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flaxdiff_tpu.serving import (DeadlineExceeded, PoissonWorkloadSpec,
+                                  RequestState, SampleRequest,
+                                  SchedulerClosed, SchedulerConfig,
+                                  ServingScheduler, build_workload,
+                                  bucket_up, nfe_bucket, replay)
+from flaxdiff_tpu.serving import scheduler as sched_mod
+from flaxdiff_tpu.telemetry import Telemetry
+
+
+# ---------------------------------------------------------------------------
+# Pure helpers
+# ---------------------------------------------------------------------------
+
+def test_bucket_helpers():
+    assert bucket_up(1, (1, 2, 4)) == 1
+    assert bucket_up(3, (1, 2, 4)) == 4
+    assert bucket_up(9, (1, 2, 4)) == 4      # capped at max bucket
+    assert nfe_bucket(1) == 1
+    assert nfe_bucket(5) == 8
+    assert nfe_bucket(64) == 64
+
+
+def test_request_validation():
+    with pytest.raises(ValueError, match="diffusion_steps"):
+        SampleRequest(diffusion_steps=0)
+    r = SampleRequest(prompts=["a", "b", "c"])
+    assert r.num_samples == 3                # prompts drive the block
+
+
+def test_poisson_workload_deterministic():
+    spec = PoissonWorkloadSpec(
+        n_requests=16, rate_hz=8.0, seed=99,
+        mix=[{"resolution": 8, "diffusion_steps": 4},
+             {"resolution": 8, "diffusion_steps": 8}])
+    w1, w2 = build_workload(spec), build_workload(spec)
+    assert [t for t, _ in w1] == [t for t, _ in w2]
+    assert [r.seed for _, r in w1] == [r.seed for _, r in w2]
+    assert [r.diffusion_steps for _, r in w1] \
+        == [r.diffusion_steps for _, r in w2]
+    # arrivals strictly increase; both NFEs drawn
+    ts = [t for t, _ in w1]
+    assert all(b > a for a, b in zip(ts, ts[1:]))
+    assert {r.diffusion_steps for _, r in w1} == {4, 8}
+    # a different seed is a different workload
+    assert [t for t, _ in build_workload(
+        PoissonWorkloadSpec(n_requests=16, rate_hz=8.0, seed=100,
+                            mix=spec.mix))] != ts
+
+
+# ---------------------------------------------------------------------------
+# FakeEngine: the scheduler's engine contract without jax
+# ---------------------------------------------------------------------------
+
+class FakeEngine:
+    """Deterministic jax-free engine: result rows are f(seed); advance
+    moves each row min(remaining, round_steps); per-call counters let
+    tests assert what compute was (not) spent."""
+
+    def __init__(self, step_delay_s: float = 0.0):
+        self.prepared = []
+        self.advance_calls = []
+        self.finalize_calls = []
+        self.step_delay_s = step_delay_s
+        self.telemetry = Telemetry(enabled=False)
+
+    def group_key(self, req):
+        return (req.resolution, req.sampler, req.num_samples)
+
+    def prepare(self, req, future, submit_t, admit_t):
+        st = RequestState(req=req, future=future, submit_t=submit_t,
+                          admit_t=admit_t, group=self.group_key(req),
+                          x=None, rng=None, state=None, pairs=None,
+                          terminal_t=0.0, cond=None, uncond=None)
+        self.prepared.append(req)
+        return st
+
+    def advance(self, rows, bucket, round_steps):
+        self.advance_calls.append((len(rows), bucket, round_steps))
+        if self.step_delay_s:
+            time.sleep(self.step_delay_s)
+        finished = []
+        for r in rows:
+            r.done += min(r.remaining, round_steps)
+            r.rounds += 1
+            if r.remaining <= 0:
+                finished.append(r)
+        return finished, 0.0
+
+    def finalize(self, rows, bucket):
+        self.finalize_calls.append((len(rows), bucket))
+        out = np.stack([np.full((r.req.num_samples, 2, 2, 1),
+                                float(r.req.seed)) for r in rows])
+        return out, 0.0
+
+
+def _fake_scheduler(tel=None, **cfg_kwargs):
+    eng = FakeEngine()
+    tel = tel or Telemetry(enabled=False)
+    cfg = SchedulerConfig(**{"round_steps": 4,
+                             "batch_buckets": (1, 2, 4), **cfg_kwargs})
+    return eng, ServingScheduler(engine=eng, config=cfg, telemetry=tel,
+                                 autostart=False)
+
+
+def test_scheduler_completes_all_and_routes_results():
+    tel = Telemetry(enabled=False)
+    eng, sched = _fake_scheduler(tel)
+    reqs = [SampleRequest(resolution=8, diffusion_steps=3 + (i % 3),
+                          sampler=("ddim", "euler")[i % 2], seed=100 + i)
+            for i in range(10)]
+    futs = [sched.submit(r) for r in reqs]
+    sched.start()
+    outs = [f.result(timeout=10) for f in futs]
+    sched.close()
+    for r, o in zip(reqs, outs):
+        # each request got ITS OWN rows back, whatever it batched with
+        assert np.all(o.samples == float(r.seed))
+        assert o.samples.shape == (1, 2, 2, 1)
+        assert o.rounds >= 1 and o.latency_ms >= o.queue_ms
+    snap = tel.registry.snapshot()
+    assert snap["serving/requests_in"] == 10
+    assert snap["serving/requests_ok"] == 10
+    assert snap.get("serving/shed", 0) == 0
+    # two groups of 5 bucketed to 4+1 rows -> some padding happened
+    assert snap["serving/rows_real"] >= 10
+
+
+def test_heterogeneous_nfe_exits_early():
+    """A short request grouped with a long one completes in fewer
+    rounds — continuous admission, not wait-for-longest."""
+    eng, sched = _fake_scheduler(round_steps=2)
+    short = sched.submit(SampleRequest(resolution=8, diffusion_steps=2,
+                                       sampler="ddim", seed=1))
+    long = sched.submit(SampleRequest(resolution=8, diffusion_steps=8,
+                                      sampler="ddim", seed=2))
+    sched.start()
+    r_short = short.result(timeout=10)
+    r_long = long.result(timeout=10)
+    sched.close()
+    assert r_short.rounds == 1 and r_long.rounds == 4
+    # both rode the same first round (one group)
+    assert eng.advance_calls[0][0] == 2
+
+
+def test_deadline_shed_before_compute():
+    eng, sched = _fake_scheduler()
+    tel = sched.telemetry
+    doomed = sched.submit(SampleRequest(resolution=8, diffusion_steps=4,
+                                        deadline_s=0.0))
+    time.sleep(0.01)                          # deadline passes in-queue
+    ok = sched.submit(SampleRequest(resolution=8, diffusion_steps=4,
+                                    seed=5))
+    sched.start()
+    assert np.all(ok.result(timeout=10).samples == 5.0)
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=10)
+    sched.close()
+    # the shed request never reached prepare/advance
+    assert all(r.deadline_s is None for r in eng.prepared)
+    assert tel.registry.counter("serving/shed").value == 1
+
+
+def test_queue_full_sheds_at_the_door():
+    eng, sched = _fake_scheduler(max_queue=1)
+    keep = sched.submit(SampleRequest(resolution=8, diffusion_steps=2))
+    reject = sched.submit(SampleRequest(resolution=8, diffusion_steps=2))
+    with pytest.raises(DeadlineExceeded, match="queue full"):
+        reject.result(timeout=1)
+    sched.start()
+    keep.result(timeout=10)
+    sched.close()
+    assert sched.telemetry.registry.counter("serving/shed").value == 1
+
+
+def test_submit_after_close_and_drain():
+    eng, sched = _fake_scheduler()
+    futs = [sched.submit(SampleRequest(resolution=8, diffusion_steps=4,
+                                       seed=i)) for i in range(3)]
+    sched.start()
+    sched.close(drain=True)                  # drain finishes queued work
+    for f in futs:
+        assert f.result(timeout=1) is not None
+    with pytest.raises(SchedulerClosed):
+        sched.submit(SampleRequest(resolution=8)).result(timeout=1)
+
+
+def test_close_without_drain_cancels():
+    eng, sched = _fake_scheduler()
+    futs = [sched.submit(SampleRequest(resolution=8, diffusion_steps=4))
+            for _ in range(4)]
+    sched.close(drain=False)                 # never started: all cancel
+    sched.start()
+    for f in futs:
+        with pytest.raises(SchedulerClosed):
+            f.result(timeout=1)
+
+
+def test_completion_sync_seams_counted(monkeypatch):
+    """The PR-5 counting-mock contract: ALL host syncs go through the
+    module seams, and one completed batch costs exactly one
+    block_until_ready + one device_get — the dispatch loop itself
+    never syncs."""
+    blocks, gets = [], []
+    real_block = sched_mod._block_until_ready
+    real_get = sched_mod._device_get
+    monkeypatch.setattr(sched_mod, "_block_until_ready",
+                        lambda x: (blocks.append(1), real_block(x))[1])
+    monkeypatch.setattr(sched_mod, "_device_get",
+                        lambda x: (gets.append(1), real_get(x))[1])
+    eng, sched = _fake_scheduler(round_steps=16)
+    futs = [sched.submit(SampleRequest(resolution=8, diffusion_steps=4,
+                                       sampler="ddim", seed=i))
+            for i in range(3)]               # one group, one round
+    sched.start()
+    for f in futs:
+        f.result(timeout=10)
+    sched.close()
+    assert len(blocks) == 1 and len(gets) == 1
+
+
+def test_backpressure_bounds_inflight(monkeypatch):
+    """With a stalled completion thread the dispatch loop must WAIT
+    (counted), not queue unbounded completed batches."""
+    real_block = sched_mod._block_until_ready
+
+    def slow_block(x):
+        time.sleep(0.05)
+        return real_block(x)
+
+    monkeypatch.setattr(sched_mod, "_block_until_ready", slow_block)
+    tel = Telemetry(enabled=False)
+    eng = FakeEngine()
+    sched = ServingScheduler(
+        engine=eng, telemetry=tel, autostart=False,
+        config=SchedulerConfig(round_steps=8, batch_buckets=(1,),
+                               max_inflight=1))
+    futs = [sched.submit(SampleRequest(resolution=8, diffusion_steps=4,
+                                       seed=i)) for i in range(6)]
+    sched.start()
+    for f in futs:
+        f.result(timeout=20)
+    sched.close()
+    assert tel.registry.counter("serving/backpressure_waits").value > 0
+    snap = tel.registry.snapshot()
+    assert snap["serving/requests_ok"] == 6
+
+
+def test_replay_with_fake_engine():
+    eng, sched = _fake_scheduler()
+    sched.start()
+    spec = PoissonWorkloadSpec(
+        n_requests=12, rate_hz=200.0, seed=3,
+        mix=[{"resolution": 8, "diffusion_steps": 4},
+             {"resolution": 8, "diffusion_steps": 8}])
+    summary = replay(sched, build_workload(spec), timeout_s=20)
+    sched.close()
+    assert summary["completed"] == 12 and summary["shed"] == 0
+    assert summary["latency_ms"]["p50"] is not None
+    assert summary["latency_ms"]["p99"] >= summary["latency_ms"]["p50"]
+    assert summary["throughput_rps"] > 0
+
+
+def test_thread_safe_submit():
+    eng, sched = _fake_scheduler(max_queue=512)
+    sched.start()
+    futs, lock = [], threading.Lock()
+
+    def blast(base):
+        mine = [sched.submit(SampleRequest(resolution=8,
+                                           diffusion_steps=4,
+                                           seed=base + i))
+                for i in range(20)]
+        with lock:
+            futs.extend(mine)
+
+    threads = [threading.Thread(target=blast, args=(1000 * t,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    results = [f.result(timeout=20) for f in futs]
+    sched.close()
+    assert len(results) == 80
+    assert {float(r.samples.flat[0]) for r in results} \
+        == {float(r.request.seed) for r in results}
+
+
+# ---------------------------------------------------------------------------
+# Real-engine acceptance: bit-identity + warm cache
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_pipe():
+    import jax
+    import jax.numpy as jnp
+
+    from flaxdiff_tpu.inference import (DiffusionInferencePipeline,
+                                        build_model)
+    config = {
+        "model": {"name": "simple_dit", "emb_features": 32,
+                  "num_heads": 4, "num_layers": 1, "patch_size": 4,
+                  "output_channels": 1},
+        "schedule": {"name": "cosine", "timesteps": 100},
+        "predictor": "epsilon",
+    }
+    model = build_model("simple_dit", emb_features=32, num_heads=4,
+                        num_layers=1, patch_size=4, output_channels=1)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 1)),
+                        jnp.zeros((1,)), None)
+    return DiffusionInferencePipeline.from_config(config, params=params)
+
+
+def test_batched_bit_identity_with_padding_and_chunking(tiny_pipe):
+    """THE acceptance bar: requests batched, padded (buckets force a
+    padding row), NFE-masked, and chunked across rounds produce
+    bit-identical samples to solo generate_samples with the same
+    seed — including a stochastic sampler's per-step noise."""
+    tel = Telemetry(enabled=False)
+    sched = ServingScheduler(
+        pipeline=tiny_pipe, telemetry=tel, autostart=False,
+        config=SchedulerConfig(round_steps=2, batch_buckets=(4,)))
+    reqs = [
+        SampleRequest(resolution=8, channels=1, diffusion_steps=3,
+                      sampler="euler_ancestral", seed=7, use_ema=False),
+        SampleRequest(resolution=8, channels=1, diffusion_steps=5,
+                      sampler="euler_ancestral", seed=11, use_ema=False),
+        SampleRequest(resolution=8, channels=1, diffusion_steps=4,
+                      sampler="ddim", seed=3, use_ema=False),
+    ]
+    futs = [sched.submit(r) for r in reqs]
+    sched.start()
+    outs = [f.result(timeout=300) for f in futs]
+    sched.close()
+
+    for r, o in zip(reqs, outs):
+        solo = tiny_pipe.generate_samples(
+            num_samples=1, resolution=8, channels=1,
+            diffusion_steps=r.diffusion_steps, sampler=r.sampler,
+            seed=r.seed, use_ema=False)
+        np.testing.assert_array_equal(o.samples, solo)
+    snap = tel.registry.snapshot()
+    # buckets=(4,) with groups of 2 and 1 -> padding rows existed, and
+    # the padded outputs were still bit-exact above
+    assert snap["serving/rows_padded"] > 0
+    assert snap["serving/requests_ok"] == 3
+
+
+def test_multistep_state_carry_bit_identity(tiny_pipe):
+    """Multistep DPM is the hardest carry: its scan state (denoised
+    history + lambda trail, keyed on the global step index) must
+    survive chunk boundaries, masking, and batch stacking bit-exactly."""
+    sched = ServingScheduler(
+        pipeline=tiny_pipe, telemetry=Telemetry(enabled=False),
+        autostart=False,
+        config=SchedulerConfig(round_steps=2, batch_buckets=(1, 2)))
+    reqs = [SampleRequest(resolution=8, channels=1, diffusion_steps=5,
+                          sampler="multistep_dpm", seed=13,
+                          use_ema=False),
+            SampleRequest(resolution=8, channels=1, diffusion_steps=3,
+                          sampler="multistep_dpm", seed=17,
+                          use_ema=False)]
+    futs = [sched.submit(r) for r in reqs]
+    sched.start()
+    outs = [f.result(timeout=300) for f in futs]
+    sched.close()
+    for r, o in zip(reqs, outs):
+        solo = tiny_pipe.generate_samples(
+            num_samples=1, resolution=8, channels=1,
+            diffusion_steps=r.diffusion_steps, sampler=r.sampler,
+            seed=r.seed, use_ema=False)
+        np.testing.assert_array_equal(o.samples, solo)
+
+
+def test_warm_cache_never_retraces(tiny_pipe):
+    """Repeat traffic of identical request shapes must be served
+    entirely from the compiled-program cache: zero misses on the
+    second pass (the bench stage asserts the same end to end)."""
+    tel = Telemetry(enabled=False)
+    sched = ServingScheduler(
+        pipeline=tiny_pipe, telemetry=tel, autostart=False,
+        config=SchedulerConfig(round_steps=2, batch_buckets=(1, 2)))
+
+    def pass_once():
+        futs = [sched.submit(SampleRequest(
+            resolution=8, channels=1, diffusion_steps=n, sampler="ddim",
+            seed=s, use_ema=False))
+            for n, s in ((3, 1), (3, 2), (5, 9))]
+        sched.start()
+        return [f.result(timeout=300) for f in futs]
+
+    first = pass_once()
+    misses_cold = tel.registry.counter(
+        "serving/program_cache_misses").value
+    assert misses_cold > 0
+    second = pass_once()
+    sched.close()
+    assert tel.registry.counter(
+        "serving/program_cache_misses").value == misses_cold
+    assert tel.registry.counter("serving/program_cache_hits").value > 0
+    # same request, same seed -> same samples on both passes
+    for a, b in zip(first, second):
+        np.testing.assert_array_equal(a.samples, b.samples)
+
+
+def test_prompted_cfg_bit_identity():
+    """Conditioned + CFG requests through the scheduler match solo
+    prompted generation bitwise (cond/uncond row stacking is
+    output-invariant)."""
+    import jax
+    import jax.numpy as jnp
+
+    from flaxdiff_tpu.inference import (DiffusionInferencePipeline,
+                                        build_model)
+    from flaxdiff_tpu.inputs import (ConditionalInputConfig,
+                                     DiffusionInputConfig)
+    from flaxdiff_tpu.inputs.encoders import HashTextEncoder
+
+    enc = HashTextEncoder.create(features=16, max_length=8)
+    model = build_model("simple_dit", emb_features=32, num_heads=4,
+                        num_layers=1, patch_size=4, output_channels=1)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 1)),
+                        jnp.zeros((1,)), jnp.asarray(enc([""])))
+    pipe = DiffusionInferencePipeline.from_config(
+        {"model": {"name": "simple_dit", "emb_features": 32,
+                   "num_heads": 4, "num_layers": 1, "patch_size": 4,
+                   "output_channels": 1},
+         "schedule": {"name": "cosine", "timesteps": 100},
+         "predictor": "epsilon"}, params=params)
+    pipe.input_config = DiffusionInputConfig(
+        sample_data_key="sample", sample_data_shape=(8, 8, 1),
+        conditions=[ConditionalInputConfig(encoder=enc)])
+
+    sched = ServingScheduler(
+        pipeline=pipe, telemetry=Telemetry(enabled=False),
+        autostart=False,
+        config=SchedulerConfig(round_steps=2, batch_buckets=(1, 2)))
+    futs = [sched.submit(SampleRequest(
+        resolution=8, channels=1, diffusion_steps=3, sampler="ddim",
+        guidance_scale=2.0, prompts=[p], seed=s, use_ema=False))
+        for p, s in (("a red flower", 21), ("blue sky", 22))]
+    sched.start()
+    outs = [f.result(timeout=300) for f in futs]
+    sched.close()
+    for (p, s), o in zip((("a red flower", 21), ("blue sky", 22)), outs):
+        solo = pipe.generate_samples(
+            prompts=[p], resolution=8, channels=1, diffusion_steps=3,
+            sampler="ddim", guidance_scale=2.0, seed=s, use_ema=False)
+        np.testing.assert_array_equal(o.samples, solo)

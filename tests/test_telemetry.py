@@ -76,6 +76,25 @@ def test_jsonl_exporter_stream(tmp_path):
     assert recs[1]["type"] == "step_phases" and "_time" in recs[1]
 
 
+def test_raw_records_carry_epoch_tag(tmp_path):
+    """Every raw JSONL row is stamped with the hub's epoch (the PR-3
+    carried-over follow-up): rows written by a stale same-incarnation
+    driver after a coordinated restart voted a new epoch stay
+    distinguishable row by row, not just file by file."""
+    path = tmp_path / "t.jsonl"
+    hub = T.Telemetry(exporters=[T.JsonlExporter(str(path))])
+    assert hub.epoch == hub.goodput.incarnation   # default epoch source
+    hub.record_step({"step": 1, "wall": 0.5})
+    hub.set_epoch(7)                              # pod-agreed epoch wins
+    hub.record_step({"step": 2, "wall": 0.5})
+    hub.write_record({"type": "custom", "epoch": 99})  # caller's wins
+    hub.close()
+    recs = [json.loads(x) for x in open(path)]
+    assert recs[0]["epoch"] == hub.goodput.incarnation
+    assert recs[1]["epoch"] == 7
+    assert recs[2]["epoch"] == 99
+
+
 def test_prometheus_textfile_atomic_format(tmp_path):
     path = tmp_path / "metrics.prom"
     ex = T.PrometheusTextfileExporter(str(path))
@@ -396,8 +415,10 @@ def test_fit_telemetry_acceptance(mesh, tmp_path, rng):
     assert len(steps) == 6
     for r in steps:
         assert {"host", "other", "wall", "step"} <= set(r)
+        # "epoch" is the row's incarnation tag (PR 8), not a phase
         parts = sum(v for k, v in r.items()
-                    if k not in ("type", "step", "wall", "_time"))
+                    if k not in ("type", "step", "wall", "_time",
+                                 "epoch"))
         assert parts == pytest.approx(r["wall"], rel=1e-3, abs=1e-5)
     assert any("device" in r for r in steps)       # block_until_ready ran
     assert any(r.get("checkpoint", 0) > 0 for r in steps)

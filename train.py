@@ -574,14 +574,19 @@ def main(argv=None):
         # may hold a different local count — broadcast rank 0's value so
         # every host tags with the SAME epoch; divergent tags would
         # abort every future round.
+        agreed = agree_epoch(
+            coord_transport,
+            (telemetry.goodput.incarnation
+             if telemetry is not None else 0),
+            timeout=args.commit_barrier_timeout)
         coordinator = RestartCoordinator(
             coord_transport,
             barrier_timeout=args.commit_barrier_timeout,
-            epoch=agree_epoch(
-                coord_transport,
-                (telemetry.goodput.incarnation
-                 if telemetry is not None else 0),
-                timeout=args.commit_barrier_timeout))
+            epoch=agreed)
+        if telemetry is not None:
+            # stamp every raw telemetry row with the pod-agreed epoch:
+            # a stale same-incarnation driver's rows stay attributable
+            telemetry.set_epoch(agreed)
     ckpt = Checkpointer(args.checkpoint_dir, coordinator=coordinator)
     trainer = DiffusionTrainer(
         apply_fn=apply_fn, init_fn=init_fn, tx=tx, schedule=schedule,
