@@ -1669,8 +1669,14 @@ def _kill_group(child):
     except (ProcessLookupError, PermissionError, OSError):
         try:
             child.kill()
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — degrade, but visibly
+            # both the group kill and the direct kill failed: the child
+            # may be unkillable (already reaped / zombie) — note it on
+            # stderr (stdout carries the JSON protocol) so a later hung
+            # stage is attributable
+            print(f"note: stage child kill failed "
+                  f"({type(e).__name__}: {e}, pid={child.pid})",
+                  file=sys.stderr)
 # monotonic time of the last killed child: a kill leaks its tunnel lease
 # for ~10-20 min (probe_backend rationale), so the orchestrator spaces
 # the NEXT launch — whether the kill ended in a salvage, an abandoned

@@ -1,0 +1,18 @@
+"""Graph-hygiene analyzer: AST + jaxpr static analysis of the
+framework's hot-path invariants (docs/ANALYSIS.md).
+
+Public surface:
+    run(...)            one-call orchestration -> Report
+    Finding, Report     result types
+    ALLOWLIST           the one place grandfathered budgets live
+    hot_programs()      the traced program inventory (programs.py)
+
+CLI: `python -m flaxdiff_tpu.analysis` / `python scripts/lint.py`.
+Importing this package does NOT import jax — only the graph rules and
+programs modules do, lazily, so pure-AST runs stay dependency-free.
+"""
+from .framework import (ALLOWLIST, AST_RULES, GRAPH_RULES, Finding,
+                        Report, all_rules, run, stable_json)
+
+__all__ = ["ALLOWLIST", "AST_RULES", "GRAPH_RULES", "Finding",
+           "Report", "all_rules", "run", "stable_json"]

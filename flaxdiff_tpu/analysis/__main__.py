@@ -1,0 +1,7 @@
+"""`python -m flaxdiff_tpu.analysis` — the unified lint CLI."""
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,81 @@
+"""One CLI for the whole static-analysis suite.
+
+    python scripts/lint.py                  # everything, text report
+    python scripts/lint.py --json           # stable machine output
+    python -m flaxdiff_tpu.analysis         # same tool
+    python scripts/lint.py --rules host-sync,silent-except --no-graph
+    python scripts/lint.py --root some/tree --rules silent-except
+
+Exit code 0 = every rule within its allowlist budget; 1 = over-budget
+findings (printed to stderr). `--json` prints ONE json object to
+stdout, byte-stable across runs on an unchanged tree (sorted keys,
+sorted findings, no timestamps or absolute paths) — diff two runs to
+diff the findings. `--root` scans a custom file/tree with EMPTY
+allowlists and rule dir-scoping dropped (fixture mode — the contract
+the old standalone scripts/check_*.py gates had); graph rules are
+skipped there because they audit traced programs, not files.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="lint",
+        description="flaxdiff_tpu graph-hygiene analyzer "
+                    "(docs/ANALYSIS.md)")
+    ap.add_argument("--json", action="store_true",
+                    help="stable machine-readable report on stdout")
+    ap.add_argument("--root", default=None,
+                    help="scan this file/tree with EMPTY allowlists "
+                         "and dir scoping dropped (fixture mode); "
+                         "default: the repo's production roots with "
+                         "the central allowlist")
+    ap.add_argument("--docs", default=None,
+                    help="metric reference markdown for the "
+                         "metric-name rule (default: "
+                         "docs/OBSERVABILITY.md)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run "
+                         "(default: all)")
+    ap.add_argument("--no-graph", action="store_true",
+                    help="skip the jaxpr analyzers (pure-AST run, no "
+                         "jax import)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    args = ap.parse_args(argv)
+
+    from . import framework
+
+    if args.list_rules:
+        from . import ast_rules  # noqa: F401 — registers
+        if not args.no_graph:
+            from . import graph_rules  # noqa: F401 — registers
+        for rid, rule in sorted(framework.all_rules().items()):
+            print(f"{rid:20s} {rule.doc}  [{rule.docs}]")
+        return 0
+
+    if not args.no_graph and args.root is None:
+        # the graph rules trace programs: never let lint grab a real
+        # accelerator. Harmless if a backend already initialized (the
+        # in-process tier-1 tests run under JAX_PLATFORMS=cpu anyway).
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    rule_ids = ([r.strip() for r in args.rules.split(",") if r.strip()]
+                if args.rules else None)
+    report = framework.run(rule_ids=rule_ids, root=args.root,
+                           docs_path=args.docs,
+                           with_graph=not args.no_graph)
+    if args.json:
+        print(framework.stable_json(report))
+    else:
+        report.render_text()
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,167 @@
+"""The REAL hot programs, traced for the graph analyzers.
+
+Builds every program the framework actually dispatches on the hot
+paths — `make_train_step` (plain, gated), its monitored twin, a
+bf16-policy variant (the upcast audit's subject), and the serving
+layer's DDIM / Euler-ancestral chunk + terminal programs plus the solo
+single-scan program — around a deliberately tiny conv model. The model
+interior is irrelevant to the invariants being checked (RNG lineage,
+callbacks, upcast traffic live in the STEP/SAMPLER code, not the
+backbone); tiny keeps `jax.make_jaxpr` tracing sub-second per program.
+Nothing here compiles or touches a device: `make_jaxpr` is abstract
+evaluation, so the global-reduction XLA-CPU compile trap
+(`_finite_only_gate` docstring) does not apply.
+
+Used by the CLI (scripts/lint.py) and the tier-1 clean-pass tests in
+tests/test_analysis.py: the acceptance bar is ZERO rng-key-reuse and
+callback-leak findings on every program below.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _tiny_model():
+    import flax.linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, t, cond=None):
+            h = nn.Conv(8, (3, 3))(x)
+            return nn.Conv(x.shape[-1], (3, 3))(jnp.tanh(h))
+
+    model = Tiny()
+
+    def apply_fn(params, x, t, cond):
+        return model.apply({"params": params}, x, t, None)
+
+    def init_fn(key):
+        return model.init(key, jnp.zeros((1, 8, 8, 1)),
+                          jnp.zeros((1,)))["params"]
+
+    return apply_fn, init_fn
+
+
+@functools.lru_cache(maxsize=None)
+def _train_pieces():
+    import optax
+
+    from ..predictors import EpsilonPredictionTransform
+    from ..schedulers import CosineNoiseSchedule
+    from ..trainer.train_state import TrainState
+
+    apply_fn, init_fn = _tiny_model()
+    key = jax.random.PRNGKey(0)
+    init_key, train_key = jax.random.split(key)
+    state = TrainState.create(apply_fn=apply_fn,
+                              params=init_fn(init_key),
+                              tx=optax.adam(1e-3), rng=train_key)
+    batch = {"sample": jnp.zeros((2, 8, 8, 1), jnp.float32)}
+    schedule = CosineNoiseSchedule(timesteps=100)
+    transform = EpsilonPredictionTransform()
+    return apply_fn, state, batch, schedule, transform
+
+
+def train_step_jaxpr(monitored: bool = False, bf16: bool = False):
+    from ..telemetry.numerics import NumericsConfig
+    from ..trainer.train_step import TrainStepConfig, make_train_step
+    from ..typing import Policy
+
+    apply_fn, state, batch, schedule, transform = _train_pieces()
+    numerics = (NumericsConfig(per_module=True, skip_nonfinite=True)
+                if monitored else None)
+    step = make_train_step(
+        apply_fn, schedule, transform,
+        TrainStepConfig(normalize=False),
+        policy=Policy() if bf16 else None,
+        numerics=numerics,
+        gate_nonfinite=True)
+    return jax.make_jaxpr(step)(state, batch)
+
+
+@functools.lru_cache(maxsize=None)
+def _sampler_pieces(sampler_name: str):
+    from ..predictors import EpsilonPredictionTransform
+    from ..samplers import SAMPLER_REGISTRY, DiffusionSampler
+    from ..schedulers import CosineNoiseSchedule
+
+    apply_fn, state, _, _, _ = _train_pieces()
+    params = state.params
+
+    def model_fn(p, x, t, cond):
+        return apply_fn(p, x, t, cond)
+
+    ds = DiffusionSampler(model_fn, CosineNoiseSchedule(timesteps=100),
+                          EpsilonPredictionTransform(),
+                          SAMPLER_REGISTRY[sampler_name]())
+    return ds, params
+
+
+def chunk_program_jaxpr(sampler_name: str, rows: int = 2,
+                        round_steps: int = 2):
+    """The serving layer's continuous-batching round program
+    (`DiffusionSampler.make_chunk_program`) with the exact input
+    layout `SamplerProgramEngine.advance` feeds it."""
+    ds, params = _sampler_pieces(sampler_name)
+    prog = ds.make_chunk_program(round_steps)
+    x = jnp.zeros((rows, 1, 8, 8, 1), jnp.float32)
+    keys = jnp.stack([jax.random.PRNGKey(i) for i in range(rows)])
+    pairs = jnp.zeros((rows, round_steps, 2), jnp.float32)
+    n_act = jnp.zeros((rows,), jnp.int32)
+    offsets = jnp.zeros((rows,), jnp.int32)
+    # per-row sampler state, stacked the way engine._stack_rows does
+    # (stateless samplers carry an empty pytree; multistep ones stack)
+    row_states = [ds.sampler.init_state(
+        jnp.zeros((1, 8, 8, 1), jnp.float32)) for _ in range(rows)]
+    state = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                   *row_states)
+    return jax.make_jaxpr(prog)(params, x, keys, pairs, n_act, offsets,
+                                None, None, state)
+
+
+def terminal_program_jaxpr(sampler_name: str, rows: int = 2):
+    ds, params = _sampler_pieces(sampler_name)
+    prog = ds.make_terminal_program()
+    x = jnp.zeros((rows, 1, 8, 8, 1), jnp.float32)
+    t_term = jnp.zeros((rows,), jnp.float32)
+    return jax.make_jaxpr(prog)(params, x, t_term, None, None)
+
+
+def solo_program_jaxpr(sampler_name: str = "ddim", steps: int = 4):
+    """The solo single-scan trajectory program generate_samples runs."""
+    ds, params = _sampler_pieces(sampler_name)
+    shape = (2, 8, 8, 1)
+    prog = ds._get_program(steps, shape, None, 0.0)
+    x = jnp.zeros(shape, jnp.float32)
+    key = jax.random.PRNGKey(0)
+    return jax.make_jaxpr(prog)(params, x, key, None, None)
+
+
+# the inventory the CLI and the tier-1 clean-pass tests iterate
+PROGRAM_BUILDERS = {
+    "train_step": lambda: train_step_jaxpr(),
+    "train_step_monitored": lambda: train_step_jaxpr(monitored=True),
+    "train_step_bf16": lambda: train_step_jaxpr(bf16=True),
+    "chunk_ddim": lambda: chunk_program_jaxpr("ddim"),
+    "chunk_euler_ancestral":
+        lambda: chunk_program_jaxpr("euler_ancestral"),
+    "terminal_ddim": lambda: terminal_program_jaxpr("ddim"),
+    "solo_ddim": lambda: solo_program_jaxpr("ddim"),
+}
+
+
+def hot_programs(names: Optional[List[str]] = None
+                 ) -> List[Tuple[str, object]]:
+    """[(name, ClosedJaxpr)] for the graph rules. Traces on whatever
+    backend jax resolves — the CLI pins JAX_PLATFORMS=cpu before any
+    backend initializes so lint never grabs an accelerator."""
+    sel = names if names is not None else sorted(PROGRAM_BUILDERS)
+    unknown = [n for n in sel if n not in PROGRAM_BUILDERS]
+    if unknown:
+        raise ValueError(f"unknown program(s) {unknown}; known: "
+                         f"{sorted(PROGRAM_BUILDERS)}")
+    return [(name, PROGRAM_BUILDERS[name]()) for name in sel]

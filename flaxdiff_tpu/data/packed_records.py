@@ -204,8 +204,14 @@ class PackedRecordReader:
     def __del__(self):
         try:
             self.close()
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — degrade, but visibly
+            # native pr_close failing at GC time is a leaked handle or
+            # a torn library state; record it instead of swallowing
+            # (the profiling.trace idiom — silent-except gate)
+            from ..resilience.events import record_event
+            record_event("warning", "data.reader_close",
+                         detail=f"{type(e).__name__}: {e} "
+                                f"(path={getattr(self, 'path', '?')})")
 
 
 def decode_standard_record(entries: Dict[str, bytes]) -> Dict[str, Any]:

@@ -96,8 +96,14 @@ class PythonPackedReader:
     def __del__(self):
         try:
             self.close()
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — degrade, but visibly
+            # a GC-time close failure usually means a leaked handle or
+            # a double-close bug; leave a trace instead of swallowing
+            # (the profiling.trace idiom — silent-except gate)
+            from ..resilience.events import record_event
+            record_event("warning", "data.reader_close",
+                         detail=f"{type(e).__name__}: {e} "
+                                f"(path={self._path})")
 
 
 @dataclasses.dataclass

@@ -252,7 +252,7 @@ class AnomalyConfig:
 @dataclasses.dataclass(frozen=True)
 class Anomaly:
     kind: str           # nonfinite_grad | nonfinite_loss | abnormal_loss
-    #                   # | loss_spike | grad_spike
+    #                   # | loss_spike | grad_spike | update_ratio_spike
     metric: str         # the series that triggered (loss / grad_norm / ...)
     value: float
     step: Optional[int] = None
@@ -311,6 +311,10 @@ class AnomalyDetector:
         alpha = 2.0 / (config.window + 1.0)
         self._loss = _Ewm(alpha)
         self._grad = _Ewm(alpha)
+        # per-module update_ratio EWMs, keyed by module name (created
+        # lazily as modules appear in the aux — module sets are static
+        # per model, so this never grows past the module count)
+        self._mod_ratio: Dict[str, _Ewm] = {}
         self.anomalies: List[Anomaly] = []
 
     # lazy hub/log resolution: the process-global defaults may be
@@ -399,11 +403,57 @@ class AnomalyDetector:
                 self._grad.update(grad_norm)
         return out
 
+    def observe_modules(self, step: int,
+                        ratios: Dict[str, float]) -> List[Anomaly]:
+        """Per-module update-ratio drift: one-sided z-score per module
+        over its own EMA (the same machinery as the global loss /
+        grad-norm series). The global `update_ratio` hides a single
+        module's effective-LR running away when the rest of the model
+        dwarfs it — the per-module series is where adapter/embedding
+        blowups show first. Spikes are SOFT anomalies (warn only:
+        evidence, not proof) and never update the EMA."""
+        out: List[Anomaly] = []
+        for mod, v in sorted(ratios.items()):
+            v = float(v)
+            if not math.isfinite(v):
+                continue    # non-finite steps are the hard triggers' job
+            ewm = self._mod_ratio.setdefault(
+                mod, _Ewm(2.0 / (self.config.window + 1.0)))
+            z = ewm.zscore(v)
+            if ewm.n >= self.config.min_steps and z > self.config.zscore:
+                out.append(self._emit(Anomaly(
+                    "update_ratio_spike", f"module/{mod}/update_ratio",
+                    v, step=step, zscore=z)))
+            else:
+                ewm.update(v)
+        return out
+
+    @staticmethod
+    def module_update_ratios(flat_aux: Dict[str, float]
+                             ) -> Dict[str, float]:
+        """`{module: update_ratio}` out of a `flatten_aux` result."""
+        out: Dict[str, float] = {}
+        for key, val in flat_aux.items():
+            parts = key.split("/")
+            if (len(parts) == 4 and parts[0] == "numerics"
+                    and parts[1] == "module"
+                    and parts[3] == "update_ratio"):
+                out[parts[2]] = float(val)
+        return out
+
     def observe_aux(self, step: int,
                     flat_aux: Dict[str, float]) -> List[Anomaly]:
-        """`observe` from a `flatten_aux` result."""
-        return self.observe(
+        """`observe` from a `flatten_aux` result, plus the per-module
+        update-ratio drift check. Hard anomalies short-circuit the
+        module pass: a gated/poisoned step's ratios are artifacts (the
+        update never landed) and must not teach the module EMAs."""
+        out = self.observe(
             step,
             loss=flat_aux.get("numerics/loss", float("nan")),
             grad_norm=flat_aux.get("numerics/grad_norm", float("nan")),
             grad_nonfinite=flat_aux.get("numerics/grad_nonfinite", 0.0))
+        if any(a.hard for a in out):
+            return out
+        out.extend(self.observe_modules(
+            step, self.module_update_ratios(flat_aux)))
+        return out

@@ -31,6 +31,16 @@ class TrainState(flax.struct.PyTreeNode):
     # — even at log_every=1. None (default) keeps the pytree identical
     # to pre-ring checkpoints.
     loss_ring: Optional[jax.Array] = None
+    # Device-resident non-finite-gate visibility counter
+    # (TrainerConfig.gate_counter): cumulative [3] int32 of elements the
+    # elementwise `_finite_only_gate` masked in params / opt_state /
+    # ema_params, accumulated IN-GRAPH so the silent masking is
+    # observable without a per-step sync (the host reads it once per
+    # log window). None (default) keeps the pytree identical to
+    # pre-counter checkpoints AND keeps the step program free of the
+    # all-leaves reduction that blows up XLA CPU compile (see the
+    # gate's docstring) — opt in per run.
+    gate_events: Optional[jax.Array] = None
     apply_fn: Callable = flax.struct.field(pytree_node=False, default=None)
     tx: optax.GradientTransformation = flax.struct.field(
         pytree_node=False, default=None)
@@ -40,7 +50,8 @@ class TrainState(flax.struct.PyTreeNode):
                tx: optax.GradientTransformation, rng: PRNGKey,
                ema_decay: Optional[float] = 0.999,
                dynamic_scale: Optional[Any] = None,
-               loss_ring_size: int = 0) -> "TrainState":
+               loss_ring_size: int = 0,
+               gate_counter: bool = False) -> "TrainState":
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -51,6 +62,8 @@ class TrainState(flax.struct.PyTreeNode):
             dynamic_scale=dynamic_scale,
             loss_ring=(jnp.zeros((loss_ring_size,), jnp.float32)
                        if loss_ring_size > 0 else None),
+            gate_events=(jnp.zeros((3,), jnp.int32)
+                         if gate_counter else None),
             apply_fn=apply_fn,
             tx=tx,
         )

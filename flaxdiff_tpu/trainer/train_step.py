@@ -117,7 +117,13 @@ def _nonfinite_gate(new_state: TrainState, state: TrainState, grads,
     the poisoned update never lands, so the live state (and therefore
     any checkpoint taken from it) stays finite without the host ever
     fetching the loss. The step counter still advances: the next step
-    folds a fresh rng. Returns `(gated_state, ok)`."""
+    folds a fresh rng. Returns `(gated_state, ok)`.
+
+    With `state.gate_events` carried (TrainerConfig.gate_counter), a
+    withheld step also accumulates its non-finite element counts into
+    the visibility counter — the monitored twin must count like the
+    plain step's `_finite_only_gate` or cadence steps would be a hole
+    in the gate-activation series."""
     from ..telemetry.numerics import tree_nonfinite_count
     ok = jnp.logical_and(tree_nonfinite_count(grads) == 0,
                          jnp.isfinite(loss))
@@ -126,11 +132,22 @@ def _nonfinite_gate(new_state: TrainState, state: TrainState, grads,
         return jax.tree_util.tree_map(
             lambda a, b: jnp.where(ok, a, b), n, o)
 
+    gate_events = state.gate_events
+    if gate_events is not None:
+        zero = jnp.zeros((), jnp.int32)
+        counts = jnp.stack([
+            tree_nonfinite_count(new_state.params),
+            tree_nonfinite_count(new_state.opt_state),
+            (tree_nonfinite_count(new_state.ema_params)
+             if state.ema_params is not None else zero)])
+        gate_events = gate_events + jnp.where(ok, 0, counts)
+
     gated = new_state.replace(
         params=gate(new_state.params, state.params),
         opt_state=gate(new_state.opt_state, state.opt_state),
         ema_params=(gate(new_state.ema_params, state.ema_params)
-                    if state.ema_params is not None else None))
+                    if state.ema_params is not None else None),
+        gate_events=gate_events)
     return gated, ok
 
 
@@ -154,16 +171,39 @@ def _finite_only_gate(new_state: TrainState,
     forms withhold the whole step; they differ only for partially
     non-finite updates, where this one commits the still-finite
     elements and the anomaly detector (which sees the window losses at
-    log cadence) remains the recovery mechanism."""
+    log cadence) remains the recovery mechanism.
+
+    Visibility (PR 5 follow-up): with `state.gate_events` present
+    (TrainerConfig.gate_counter) the gate also counts, IN-GRAPH, how
+    many elements it masked in params / opt_state / ema_params and
+    accumulates the three counts into the carried [3] int32 — masking
+    is otherwise silent by design, and "the gate fired N times" is the
+    difference between one poisoned batch and a quietly-diverging run.
+    The count is per-leaf-summed via the same `tree_nonfinite_count`
+    the monitored aux uses; note it re-introduces a reduction over
+    every leaf, which is exactly the XLA-CPU compile blowup the
+    elementwise gate exists to avoid — that is why the counter is
+    opt-in instead of free with the gate."""
     def gate(n, o):
         return jax.tree_util.tree_map(
             lambda a, b: jnp.where(jnp.isfinite(a), a, b), n, o)
+
+    gate_events = state.gate_events
+    if gate_events is not None:
+        from ..telemetry.numerics import tree_nonfinite_count
+        zero = jnp.zeros((), jnp.int32)
+        gate_events = gate_events + jnp.stack([
+            tree_nonfinite_count(new_state.params),
+            tree_nonfinite_count(new_state.opt_state),
+            (tree_nonfinite_count(new_state.ema_params)
+             if state.ema_params is not None else zero)])
 
     return new_state.replace(
         params=gate(new_state.params, state.params),
         opt_state=gate(new_state.opt_state, state.opt_state),
         ema_params=(gate(new_state.ema_params, state.ema_params)
-                    if state.ema_params is not None else None))
+                    if state.ema_params is not None else None),
+        gate_events=gate_events)
 
 
 def make_train_step(

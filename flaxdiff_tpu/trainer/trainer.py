@@ -67,6 +67,15 @@ def _fetch_ring(ring):
     return np.asarray(jax.device_get(ring))
 
 
+def _fetch_gate_events(arr):
+    """Read the [3] in-graph gate-activation counter
+    (TrainState.gate_events) back to host as int64 — piggybacks on the
+    log window, where the window fetch has already settled the
+    pipeline. Module-level for the same counting-mock contract as
+    _fetch_losses."""
+    return np.asarray(jax.device_get(arr)).astype(np.int64)
+
+
 # a "compile" first step no slower than this multiple of the median
 # steady step did not actually compile (warm persistent cache) and is
 # re-attributed productive — see GoodputLedger.reattribute
@@ -173,6 +182,18 @@ class TrainerConfig:
     # per-save loss fetch; disabling it restores the exact ungated
     # step program AND the legacy synchronous save-cadence loss check.
     gate_nonfinite: bool = True
+    # Gate-activation visibility (PR 5 follow-up): carry a [3] int32
+    # counter in the TrainState that the in-graph gate increments with
+    # the number of params/opt-state/EMA elements it masked; the fit
+    # loop reads it once per log window (no extra pipeline sync — the
+    # window fetch already settled everything) and surfaces deltas as
+    # `numerics/gate_activations*` counters + a `gate_activated`
+    # event. OPT-IN: the count is a reduction over every state leaf,
+    # which measurably blows up XLA CPU compile of the step (the exact
+    # pathology `_finite_only_gate`'s elementwise design avoids), and
+    # the extra leaf changes the checkpoint pytree — flip per run, not
+    # mid-run. Requires gate_nonfinite.
+    gate_counter: bool = False
 
 
 class DiffusionTrainer:
@@ -230,6 +251,9 @@ class DiffusionTrainer:
         if config.anomaly_action not in ANOMALY_ACTIONS:
             raise ValueError(f"anomaly_action {config.anomaly_action!r} "
                              f"not in {ANOMALY_ACTIONS}")
+        if config.gate_counter and not config.gate_nonfinite:
+            raise ValueError("gate_counter counts the in-graph gate's "
+                             "activations — it requires gate_nonfinite")
 
         step_cfg = TrainStepConfig(
             uncond_prob=config.uncond_prob,
@@ -284,7 +308,8 @@ class DiffusionTrainer:
             return TrainState.create(
                 apply_fn=apply_fn, params=params, tx=tx, rng=train_key,
                 ema_decay=config.ema_decay, dynamic_scale=dynamic_scale,
-                loss_ring_size=max(config.loss_ring, 0))
+                loss_ring_size=max(config.loss_ring, 0),
+                gate_counter=config.gate_counter)
 
         key = jax.random.PRNGKey(config.seed)
         state_shapes = jax.eval_shape(create_state, key)
@@ -595,6 +620,12 @@ class DiffusionTrainer:
                 "TrainerConfig.loss_ring > 0 but the TrainState carries "
                 "no ring (state restored from a pre-ring checkpoint?)")
         ring_pending = [0]          # count of steps since the last fetch
+        # gate-activation visibility: baseline the cumulative in-graph
+        # counter ONCE at fit start (the state is at rest here — a
+        # resumed/rolled-back state legitimately carries prior counts),
+        # then surface per-window deltas at log cadence
+        gate_prev = (_fetch_gate_events(self.state.gate_events)
+                     if self.state.gate_events is not None else None)
         peak = device_peak_flops()
         flops = None
         history: Dict[str, Any] = {"steps": [], "loss": [], "imgs_per_sec": [],
@@ -987,6 +1018,34 @@ class DiffusionTrainer:
                         vals = _fetch_losses([v for _, v in window])
                     if nan_pending:
                         vals[-1], nan_pending = float("nan"), False
+                    if gate_prev is not None \
+                            and self.state.gate_events is not None:
+                        # per-window delta of the in-graph gate counter
+                        # (the window fetch above already settled the
+                        # pipeline; this read costs no extra sync).
+                        # Clamped at 0: a rollback rewinds the
+                        # cumulative counter below the baseline.
+                        ge = _fetch_gate_events(self.state.gate_events)
+                        delta = np.maximum(ge - gate_prev, 0)
+                        gate_prev = ge
+                        if int(delta.sum()):
+                            tel.counter("numerics/gate_activations") \
+                                .inc(int(delta.sum()))
+                            for part, d in zip(
+                                    ("params", "opt_state", "ema"),
+                                    delta):
+                                if int(d):
+                                    tel.counter(
+                                        f"numerics/gate_activations/"
+                                        f"{part}").inc(int(d))
+                            events.record(
+                                "gate_activated", "train.step",
+                                detail=f"in-graph non-finite gate "
+                                       f"masked {int(delta.sum())} "
+                                       f"element(s) this window "
+                                       f"(params/opt/ema = "
+                                       f"{delta.tolist()})",
+                                step=i + 1)
                     # Mid-window non-finite losses are VISIBILITY, not a
                     # verdict: with the in-graph gate a poisoned batch's
                     # update never landed, so a finite cadence loss

@@ -1,21 +1,11 @@
 #!/usr/bin/env python
-"""Static gate: no NEW silent exception swallowing.
+"""(shim) Silent-exception gate — now rule `silent-except` of the
+unified analyzer (`flaxdiff_tpu/analysis/`, CLI `scripts/lint.py`).
 
-The observability layer's worst enemy is `except Exception: pass` — a
-failure that leaves no counter, no event, no log line is invisible to
-the telemetry/goodput accounting this repo now runs on. This pass walks
-the AST of every production Python file and fails on exception handlers
-that swallow silently: a handler catching everything (bare `except`,
-`except Exception`, `except BaseException`) whose body does NOTHING
-(only `pass`/`...`) — no event record, no logging, no re-raise, no
-fallback value.
-
-Pre-existing offenders are grandfathered in ALLOWLIST (file -> max
-count); new ones fail CI (wired as a tier-1 check in
-tests/test_tools.py). Shrink the allowlist when you fix one — a file
-dropping below its budget tightens it automatically? No: budgets are
-MAXIMA; lower actual counts pass and the list should then be edited
-down (the failure message says so).
+Kept as a thin wrapper so existing invocations and muscle memory keep
+working; the rule logic, the (now EMPTY) allowlist, and the reporters
+live in the analysis package. The four historical offenders were fixed
+in PR 9 — new silent handlers fail with no grandfathering.
 
 Usage:
     python scripts/check_bare_except.py            # repo default roots
@@ -24,128 +14,29 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import ast
 import os
 import sys
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional
 
-# Grandfathered silent handlers (relpath -> max allowed). Each entry is
-# debt: fix the site to record a resilience event (or at least log),
-# then delete the line here.
-ALLOWLIST: Dict[str, int] = {
-    "flaxdiff_tpu/data/sharded_source.py": 1,   # best-effort len probe
-    "flaxdiff_tpu/data/packed_records.py": 1,   # optional index sidecar
-    "scripts/demo_sfc.py": 1,                   # optional matplotlib
-    "bench.py": 1,                              # best-effort trace close
-}
-
-# Production roots scanned by default (tests may legitimately swallow
-# in teardown helpers; they are reviewed, not gated).
-DEFAULT_ROOTS = ("flaxdiff_tpu", "scripts", "train.py", "bench.py")
-
-
-def _catches_everything(handler: ast.ExceptHandler) -> bool:
-    if handler.type is None:
-        return True
-    t = handler.type
-    names = []
-    if isinstance(t, ast.Name):
-        names = [t.id]
-    elif isinstance(t, ast.Tuple):
-        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
-    return any(n in ("Exception", "BaseException") for n in names)
-
-
-def _is_silent(handler: ast.ExceptHandler) -> bool:
-    for stmt in handler.body:
-        if isinstance(stmt, ast.Pass):
-            continue
-        if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
-                                                     ast.Constant):
-            continue        # docstring or bare `...`
-        return False        # does SOMETHING: logs, records, returns, ...
-    return True
-
-
-def scan_file(path: str) -> List[Tuple[int, str]]:
-    """(lineno, snippet) of silent catch-all handlers in one file."""
-    try:
-        with open(path, "r", encoding="utf-8") as f:
-            src = f.read()
-        tree = ast.parse(src, filename=path)
-    except (OSError, SyntaxError) as e:
-        return [(0, f"unparseable: {e}")]
-    out = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) \
-                and _catches_everything(node) and _is_silent(node):
-            out.append((node.lineno,
-                        ast.unparse(node.type) if node.type else "bare"))
-    return out
-
-
-def iter_py_files(root: str):
-    if os.path.isfile(root):
-        yield root
-        return
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames
-                       if d not in ("__pycache__", ".git")]
-        for fn in filenames:
-            if fn.endswith(".py"):
-                yield os.path.join(dirpath, fn)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
-        description="fail on new silent except-Exception-pass handlers")
+        description="fail on new silent except-Exception-pass handlers "
+                    "(shim over `scripts/lint.py --rules "
+                    "silent-except`)")
     ap.add_argument("--root", default=None,
                     help="scan this file/tree with an EMPTY allowlist "
-                         "(default: the repo's production roots with "
-                         "the grandfathered allowlist)")
+                         "(default: the repo's production roots)")
     args = ap.parse_args(argv)
 
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    from flaxdiff_tpu.analysis.cli import main as lint_main
+    fwd = ["--rules", "silent-except", "--no-graph"]
     if args.root is not None:
-        roots, allow, base = [args.root], {}, os.path.dirname(
-            os.path.abspath(args.root)) or "."
-    else:
-        roots = [os.path.join(repo, r) for r in DEFAULT_ROOTS]
-        allow, base = ALLOWLIST, repo
-
-    failures: List[str] = []
-    shrinkable: List[str] = []
-    for root in roots:
-        if not os.path.exists(root):
-            continue
-        per_file: Dict[str, List[Tuple[int, str]]] = {}
-        for path in iter_py_files(root):
-            hits = scan_file(path)
-            if hits:
-                per_file[os.path.relpath(path, base)] = hits
-        for rel, hits in sorted(per_file.items()):
-            budget = allow.get(rel, 0)
-            if len(hits) > budget:
-                for lineno, what in hits:
-                    failures.append(
-                        f"{rel}:{lineno}: silent `except {what}` with "
-                        f"empty body ({len(hits)} in file, allowlist "
-                        f"budget {budget}) — record a resilience event "
-                        f"or log before swallowing")
-            elif len(hits) < budget:
-                shrinkable.append(
-                    f"{rel}: {len(hits)} silent handler(s), budget "
-                    f"{budget} — shrink ALLOWLIST in "
-                    f"scripts/check_bare_except.py")
-    for msg in shrinkable:
-        print(f"note: {msg}")
-    if failures:
-        print("\n".join(failures), file=sys.stderr)
-        print(f"\n{len(failures)} new silent exception handler(s). "
-              f"A swallowed failure is invisible to telemetry — see "
-              f"docs/OBSERVABILITY.md.", file=sys.stderr)
-        return 1
-    return 0
+        fwd += ["--root", args.root]
+    return lint_main(fwd)
 
 
 if __name__ == "__main__":

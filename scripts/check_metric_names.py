@@ -1,27 +1,11 @@
 #!/usr/bin/env python
-"""Static gate: every metric name emitted in `flaxdiff_tpu/` must
-appear in the docs/OBSERVABILITY.md metric reference.
+"""(shim) Metric-name gate — now rule `metric-name` of the unified
+analyzer (`flaxdiff_tpu/analysis/`, CLI `scripts/lint.py`).
 
-An undocumented metric is half-observability: it shows up in a
-dashboard with no definition, no unit, no alerting guidance — and names
-drift silently ("grad_norm" vs "gradient_norm") until two dashboards
-disagree. This pass walks the AST of the production tree, collects the
-FIRST argument of every `.counter(...)` / `.gauge(...)` /
-`.histogram(...)` call — string literals exactly, f-strings by their
-leading literal prefix (`f"phase/{name}"` -> wildcard `phase/*`) — and
-checks each against the names documented in OBSERVABILITY.md
-(backtick-quoted; `<placeholder>` segments make a docs entry a
-wildcard, e.g. `numerics/module/<module>/grad_norm` covers any module).
-
-Calls whose first argument is a plain variable are invisible to the
-gate (re-export loops like `for name, v in stats: gauge(name)`): the
-names they carry must arrive through a gated call site or be
-documented by hand.
-
-Pre-existing/deliberate exceptions are grandfathered in ALLOWLIST
-(relpath -> max undocumented emissions), the same budget pattern as
-scripts/check_bare_except.py: budgets are maxima, shrink the entry when
-you document a name.
+Kept as a thin wrapper so existing invocations keep working; the rule
+logic (literal + f-string-prefix instrument names checked against the
+docs/OBSERVABILITY.md reference, `<placeholder>` wildcards) and the
+allowlist live in the analysis package.
 
 Usage:
     python scripts/check_metric_names.py                 # repo defaults
@@ -30,158 +14,34 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import ast
 import os
-import re
 import sys
-from typing import Dict, List, Optional, Set, Tuple
+from typing import List, Optional
 
-# Grandfathered undocumented emissions (relpath -> max allowed).
-ALLOWLIST: Dict[str, int] = {}
-
-DEFAULT_ROOT = "flaxdiff_tpu"
-DEFAULT_DOCS = os.path.join("docs", "OBSERVABILITY.md")
-INSTRUMENT_METHODS = ("counter", "gauge", "histogram")
-
-# a docs code span counts as a metric name when it looks like one:
-# slash-separated lowercase segments, optionally with <placeholders>
-_METRIC_RE = re.compile(r"^[a-z0-9_.<>-]+(/[a-z0-9_.<>-]+)+$")
-
-
-def emitted_names(path: str) -> List[Tuple[int, str, bool]]:
-    """(lineno, name, is_prefix) for every instrument call in one file.
-    `is_prefix` marks f-string emissions reduced to their literal
-    prefix; a plain-variable first arg yields nothing (ungateable)."""
-    with open(path, "r", encoding="utf-8") as f:
-        tree = ast.parse(f.read(), filename=path)
-    out: List[Tuple[int, str, bool]] = []
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in INSTRUMENT_METHODS
-                and node.args):
-            continue
-        arg = node.args[0]
-        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-            out.append((node.lineno, arg.value, False))
-        elif isinstance(arg, ast.JoinedStr):
-            prefix = ""
-            for part in arg.values:
-                if isinstance(part, ast.Constant) \
-                        and isinstance(part.value, str):
-                    prefix += part.value
-                else:
-                    break
-            out.append((node.lineno, prefix, True))
-    return out
-
-
-def documented_names(docs_path: str) -> Tuple[Set[str], Set[str]]:
-    """(exact, wildcard_prefixes) from every backtick span in the docs.
-    `phase/<name>` documents the prefix `phase/`; an exact name is any
-    span without placeholders that looks metric-shaped."""
-    with open(docs_path, "r", encoding="utf-8") as f:
-        text = f.read()
-    exact: Set[str] = set()
-    prefixes: Set[str] = set()
-    for span in re.findall(r"`([^`\n]+)`", text):
-        span = span.strip()
-        if not _METRIC_RE.match(span):
-            continue
-        if "<" in span:
-            prefixes.add(span.split("<", 1)[0])
-        else:
-            exact.add(span)
-    return exact, prefixes
-
-
-def is_documented(name: str, is_prefix: bool,
-                  exact: Set[str], prefixes: Set[str]) -> bool:
-    if not is_prefix:
-        return name in exact \
-            or any(p and name.startswith(p) for p in prefixes)
-    # an f-string emission is covered only by a docs wildcard that
-    # contains its literal prefix (or vice versa): f"phase/{n}" needs
-    # `phase/<name>`-style documentation, not an exact entry
-    return any(p and (name.startswith(p) or p.startswith(name))
-               for p in prefixes if name)
-
-
-def iter_py_files(root: str):
-    if os.path.isfile(root):
-        yield root
-        return
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames
-                       if d not in ("__pycache__", ".git")]
-        for fn in filenames:
-            if fn.endswith(".py"):
-                yield os.path.join(dirpath, fn)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="fail on metric names missing from the "
-                    "OBSERVABILITY.md reference")
+                    "OBSERVABILITY.md reference (shim over "
+                    "`scripts/lint.py --rules metric-name`)")
     ap.add_argument("--root", default=None,
                     help="scan this file/tree with an EMPTY allowlist "
-                         "(default: flaxdiff_tpu/ with the "
-                         "grandfathered allowlist)")
+                         "(default: flaxdiff_tpu/)")
     ap.add_argument("--docs", default=None,
                     help="markdown file holding the metric reference "
                          "(default: docs/OBSERVABILITY.md)")
     args = ap.parse_args(argv)
 
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    from flaxdiff_tpu.analysis.cli import main as lint_main
+    fwd = ["--rules", "metric-name", "--no-graph"]
     if args.root is not None:
-        root = args.root
-        allow: Dict[str, int] = {}
-        base = os.path.dirname(os.path.abspath(args.root)) or "."
-    else:
-        root = os.path.join(repo, DEFAULT_ROOT)
-        allow, base = ALLOWLIST, repo
-    docs = args.docs if args.docs is not None \
-        else os.path.join(repo, DEFAULT_DOCS)
-    if not os.path.exists(docs):
-        print(f"docs file not found: {docs}", file=sys.stderr)
-        return 1
-    exact, prefixes = documented_names(docs)
-
-    failures: List[str] = []
-    shrinkable: List[str] = []
-    per_file: Dict[str, List[Tuple[int, str, bool]]] = {}
-    for path in iter_py_files(root):
-        undocumented = [
-            (lineno, name, is_prefix)
-            for lineno, name, is_prefix in emitted_names(path)
-            if not is_documented(name, is_prefix, exact, prefixes)]
-        if undocumented:
-            per_file[os.path.relpath(path, base)] = undocumented
-    for rel, hits in sorted(per_file.items()):
-        budget = allow.get(rel, 0)
-        if len(hits) > budget:
-            for lineno, name, is_prefix in hits:
-                shown = f"{name}{{...}}" if is_prefix else name
-                failures.append(
-                    f"{rel}:{lineno}: metric {shown!r} is not in the "
-                    f"{os.path.basename(docs)} reference ({len(hits)} "
-                    f"in file, allowlist budget {budget}) — add a row "
-                    f"to the metric table (use <placeholders> for "
-                    f"dynamic segments)")
-        elif len(hits) < budget:
-            shrinkable.append(
-                f"{rel}: {len(hits)} undocumented metric(s), budget "
-                f"{budget} — shrink ALLOWLIST in "
-                f"scripts/check_metric_names.py")
-    for msg in shrinkable:
-        print(f"note: {msg}")
-    if failures:
-        print("\n".join(failures), file=sys.stderr)
-        print(f"\n{len(failures)} undocumented metric name(s). An "
-              f"undocumented series is half-observability — see "
-              f"docs/OBSERVABILITY.md 'Metric names'.", file=sys.stderr)
-        return 1
-    return 0
+        fwd += ["--root", args.root]
+    if args.docs is not None:
+        fwd += ["--docs", args.docs]
+    return lint_main(fwd)
 
 
 if __name__ == "__main__":

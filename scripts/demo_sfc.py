@@ -31,8 +31,10 @@ def _force_cpu():
     import jax
     try:
         jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
+    except Exception as e:  # noqa: BLE001 — degrade, but visibly
+        print(f"note: could not pin the cpu platform "
+              f"({type(e).__name__}: {e}); the demo may wait on an "
+              f"accelerator backend", file=sys.stderr)
 
 
 def main(argv=None):

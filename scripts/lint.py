@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""Unified static-analysis CLI — the graph-hygiene analyzer.
+
+Thin launcher over `flaxdiff_tpu.analysis.cli` (also reachable as
+`python -m flaxdiff_tpu.analysis`). Runs every AST rule (host-sync
+hygiene, never-lane-slice, silent-except, metric-name drift) over the
+production tree AND the jaxpr analyzers (RNG-key reuse, callback
+leaks, bf16->f32 upcast audit) over the real traced hot programs.
+Exit 0 = clean; 1 = over-budget findings. See docs/ANALYSIS.md.
+
+Usage:
+    python scripts/lint.py                # everything
+    python scripts/lint.py --json         # stable machine output
+    python scripts/lint.py --list-rules   # the rule catalogue
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from flaxdiff_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

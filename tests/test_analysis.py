@@ -1,0 +1,382 @@
+"""Graph-hygiene analyzer (ISSUE 9): one true-positive fixture per
+rule, clean-pass assertions on the REAL hot programs, allowlist budget
+semantics, and the custom-root CLI mode.
+
+The full-repo acceptance run (every AST rule + every jaxpr analyzer
+over the production tree, exit 0) lives in tests/test_tools.py as the
+one unified-CLI invocation; this file proves each rule actually
+DETECTS what it claims to detect — a gate that never fires is worse
+than no gate, it's false confidence.
+"""
+import ast
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flaxdiff_tpu.analysis import framework
+from flaxdiff_tpu.analysis import ast_rules as AR  # registers AST rules
+from flaxdiff_tpu.analysis import graph_rules as GR  # registers graph
+from flaxdiff_tpu.analysis.framework import (ALLOWLIST, AST_RULES,
+                                             GRAPH_RULES, Finding,
+                                             apply_budgets)
+
+
+def _check(rule_id, src, relpath="fixture.py"):
+    rule = AST_RULES[rule_id]
+    return rule.check(relpath, ast.parse(src), src)
+
+
+# -- host-sync ----------------------------------------------------------------
+
+def test_host_sync_flags_every_sync_form():
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "def hot(x, arrs):\n"
+        "    a = x.item()\n"                    # 5
+        "    jax.block_until_ready(x)\n"        # 6
+        "    b = jax.device_get(x)\n"           # 7
+        "    c = np.asarray(x)\n"               # 8
+        "    d = float(jnp.std(x))\n"           # 9
+        "    return a, b, c, d\n")
+    hits = _check("host-sync", src)
+    assert sorted(f.line for f in hits) == [5, 6, 7, 8, 9]
+
+
+def test_host_sync_blesses_the_seams_and_h2d():
+    """Syncs INSIDE the module seams are the contract, not a finding;
+    jnp.asarray is H2D upload, not a host sync."""
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def _fetch_losses(arrs):\n"
+        "    return [float(v) for v in jax.device_get(list(arrs))]\n"
+        "def _block_until_ready(x):\n"
+        "    jax.block_until_ready(x)\n"
+        "def upload(x):\n"
+        "    return jnp.asarray(x)\n"           # H2D: fine
+        "def cfg(v):\n"
+        "    return float(v)\n")                # plain cast: fine
+    assert _check("host-sync", src) == []
+
+
+def test_host_sync_scoping():
+    """Repo mode: only trainer/serving/samplers files are in scope."""
+    rule = AST_RULES["host-sync"]
+    assert rule.applies("flaxdiff_tpu/trainer/trainer.py")
+    assert rule.applies("flaxdiff_tpu/serving/scheduler.py")
+    assert not rule.applies("flaxdiff_tpu/telemetry/metrics.py")
+    assert not rule.applies("scripts/diagnose_run.py")
+    assert rule.applies("anything.py", scoped=False)
+
+
+# -- pallas-lane-slice --------------------------------------------------------
+
+def test_lane_slice_flags_bounded_last_axis():
+    src = (
+        "def _bad_kernel(x_ref, o_ref):\n"
+        "    o_ref[:, :64] = x_ref[:, :64]\n"       # both sides flagged
+        "def also_bad(q_ref, o_ref):\n"
+        "    o_ref[..., 0:8] = q_ref[..., 0:8] * 2\n")
+    hits = _check("pallas-lane-slice", src)
+    assert len(hits) == 4
+    assert all(f.line in (2, 4) for f in hits)
+
+
+def test_lane_slice_accepts_kernel_idioms():
+    """The repo's actual kernel patterns — block reads, full-width
+    stores, python-tuple slicing of the refs vararg — all pass; and a
+    NON-kernel function may slice freely."""
+    src = (
+        "def _good_kernel(x_ref, s_ref, o_ref):\n"
+        "    x = x_ref[0]\n"
+        "    o_ref[...] = x\n"
+        "    o_ref[0, 0] = x.sum()\n"
+        "def _unpack_kernel(*refs, nviews):\n"
+        "    x_ref = refs[0]\n"
+        "    s_refs = refs[1:1 + 2 * nviews:2]\n"    # tuple slice: fine
+        "    x_ref[0] = x_ref[0] * 2\n"
+        "def host_helper(arr):\n"
+        "    return arr[:, :64]\n")                  # not a kernel
+    assert _check("pallas-lane-slice", src) == []
+
+
+# -- silent-except (ported from the standalone gate's tests) ------------------
+
+def test_silent_except_flags_new_offender():
+    src = (
+        "def f():\n"
+        "    try:\n"
+        "        risky()\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "    try:\n"
+        "        risky()\n"
+        "    except (ValueError, BaseException):\n"
+        "        ...\n")
+    hits = _check("silent-except", src)
+    assert sorted(f.line for f in hits) == [4, 8]
+
+
+def test_silent_except_accepts_handlers_that_act():
+    src = (
+        "def f():\n"
+        "    try:\n"
+        "        risky()\n"
+        "    except Exception as e:\n"
+        "        record_event('x', 'y', detail=repr(e))\n"
+        "    try:\n"
+        "        risky()\n"
+        "    except ValueError:\n"      # narrow catch: allowed silent
+        "        pass\n"
+        "    try:\n"
+        "        risky()\n"
+        "    except Exception:\n"
+        "        raise RuntimeError('context')\n")
+    assert _check("silent-except", src) == []
+
+
+def test_bare_except_allowlist_is_empty():
+    """Satellite: the four grandfathered sites were fixed — the budget
+    must STAY empty (re-adding debt here is a review event)."""
+    assert ALLOWLIST["silent-except"] == {}
+
+
+# -- metric-name --------------------------------------------------------------
+
+def test_metric_name_wildcards_and_fstrings(tmp_path):
+    code = (
+        "def f(reg, name):\n"
+        "    reg.histogram(f'phase/{name}').observe(0.1)\n"
+        "    reg.gauge('numerics/module/Conv_0/grad_norm').set(1.0)\n"
+        "    reg.gauge(name).set(1.0)\n")          # variable: ungated
+    docs = tmp_path / "docs.md"
+    rule = AST_RULES["metric-name"]
+    old = rule.docs_path
+    try:
+        docs.write_text("- `phase/<name>` histograms\n"
+                        "- `numerics/module/<module>/<stat>` rows\n")
+        rule.docs_path = str(docs)
+        assert _check("metric-name", code) == []
+        # remove the wildcard: the f-string prefix is now undocumented
+        docs.write_text("- `numerics/module/<module>/<stat>` rows\n")
+        hits = _check("metric-name", code)
+        assert len(hits) == 1 and "phase/" in hits[0].message
+    finally:
+        rule.docs_path = old
+
+
+# -- rng-key-reuse ------------------------------------------------------------
+
+def _rng_check(fn, *args):
+    closed = jax.make_jaxpr(fn)(*args)
+    findings, stats = GRAPH_RULES["rng-key-reuse"].check("fix", closed)
+    return findings, stats
+
+
+def test_rng_reuse_double_draw_detected():
+    def f(key):
+        return (jax.random.normal(key, (2,))
+                + jax.random.normal(key, (2,)))     # REUSE
+
+    findings, stats = _rng_check(f, jax.random.PRNGKey(0))
+    assert len(findings) == 1
+    assert "reused" in findings[0].message
+    assert stats["keys_drawn"] == 2
+
+
+def test_rng_reuse_draw_after_split_detected():
+    def f(key):
+        k1, _ = jax.random.split(key)
+        return jax.random.normal(key, (2,))         # key already split
+
+    findings, _ = _rng_check(f, jax.random.PRNGKey(0))
+    assert len(findings) == 1
+
+
+def test_rng_double_split_detected():
+    def f(key):
+        a = jax.random.split(key)                   # same children
+        b = jax.random.split(key)                   # twice
+        return jax.random.normal(a[0], ()) + jax.random.normal(b[1], ())
+
+    findings, _ = _rng_check(f, jax.random.PRNGKey(0))
+    assert len(findings) == 1
+
+
+def test_rng_clean_split_lineage_passes():
+    """The framework's own derivation patterns: fold_in + split + one
+    draw per child, a carried key split each scan step (the chunk
+    program's pattern) — zero findings."""
+    def f(key, step):
+        key = jax.random.fold_in(key, step)
+        k1, k2, k3 = jax.random.split(key, 3)
+        x = jax.random.normal(k1, (2,))
+        y = jax.random.bernoulli(k2, 0.5, (2,))
+
+        def body(carry, _):
+            rng, acc = carry
+            rng, sub = jax.random.split(rng)
+            return (rng, acc + jax.random.normal(sub, (2,))), ()
+
+        (rng, acc), _ = jax.lax.scan(body, (k3, x), None, length=4)
+        return acc + y
+
+    findings, stats = _rng_check(f, jax.random.PRNGKey(0),
+                                 jnp.zeros((), jnp.int32))
+    assert findings == []
+    assert stats["keys_drawn"] >= 2
+
+
+def test_rng_scan_constant_key_detected():
+    """A key riding into a scan body as a loop CONSTANT draws the same
+    bits every iteration — the classic 'it compiled and the loss even
+    went down' key bug."""
+    def f(key):
+        def body(acc, _):
+            return acc + jax.random.normal(key, (2,)), ()   # closed over!
+
+        acc, _ = jax.lax.scan(body, jnp.zeros((2,)), None, length=4)
+        return acc
+
+    findings, _ = _rng_check(f, jax.random.PRNGKey(0))
+    assert len(findings) == 1
+    assert "scan-const" in findings[0].message
+
+
+# -- callback-leak ------------------------------------------------------------
+
+def test_callback_leak_detected_and_clean():
+    def leaky(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v) * 2, jax.ShapeDtypeStruct(
+                (2,), jnp.float32), x)
+
+    closed = jax.make_jaxpr(leaky)(jnp.ones((2,)))
+    findings, stats = GRAPH_RULES["callback-leak"].check("fix", closed)
+    assert len(findings) == 1 and stats["callbacks"] == 1
+
+    def clean(x):
+        return x * 2
+
+    closed = jax.make_jaxpr(clean)(jnp.ones((2,)))
+    findings, stats = GRAPH_RULES["callback-leak"].check("fix", closed)
+    assert findings == [] and stats["callbacks"] == 0
+
+
+def test_debug_print_is_a_callback_leak():
+    def leaky(x):
+        jax.debug.print("x={x}", x=x)
+        return x * 2
+
+    closed = jax.make_jaxpr(leaky)(jnp.ones((2,)))
+    findings, _ = GRAPH_RULES["callback-leak"].check("fix", closed)
+    assert len(findings) == 1
+
+
+# -- bf16-upcast --------------------------------------------------------------
+
+def test_upcast_audit_counts_and_budgets(monkeypatch):
+    def f(x):
+        return x.astype(jnp.float32) * 2.0
+
+    closed = jax.make_jaxpr(f)(jnp.ones((4, 8), jnp.bfloat16))
+    rule = GRAPH_RULES["bf16-upcast"]
+    findings, stats = rule.check("fix", closed)
+    assert stats == {"casts": 1, "elements": 32}
+    assert findings == []       # default budget: report-only
+    monkeypatch.setitem(framework.UPCAST_BUDGET, "fix", 16)
+    findings, stats = rule.check("fix", closed)
+    assert len(findings) == 1 and "budget of 16" in findings[0].message
+    assert stats["budget"] == 16
+
+
+# -- the real hot programs (tier-1 clean pass) --------------------------------
+
+@pytest.mark.parametrize("name", [
+    "train_step", "train_step_monitored", "chunk_ddim",
+    "chunk_euler_ancestral", "solo_ddim"])
+def test_real_programs_pass_rng_and_callback_rules(name):
+    """ISSUE 9 acceptance: zero RNG-reuse and callback findings on the
+    REAL train-step and sampler programs — the invariants PR 5/8 hand-
+    enforced, now mechanically checked against the live code."""
+    from flaxdiff_tpu.analysis.programs import hot_programs
+    [(prog_name, closed)] = hot_programs([name])
+    for rid in ("rng-key-reuse", "callback-leak"):
+        findings, _ = GRAPH_RULES[rid].check(prog_name, closed)
+        assert findings == [], (rid, [f.message for f in findings])
+
+
+def test_hot_program_inventory_traces():
+    from flaxdiff_tpu.analysis.programs import (PROGRAM_BUILDERS,
+                                                hot_programs)
+    progs = hot_programs()
+    assert [n for n, _ in progs] == sorted(PROGRAM_BUILDERS)
+    assert all(hasattr(c, "jaxpr") for _, c in progs)
+    with pytest.raises(ValueError, match="unknown program"):
+        hot_programs(["nope"])
+
+
+def test_bf16_step_upcast_within_budget():
+    """The audit's real subject: the bf16-policy train step's upcast
+    traffic stays within its pinned budget (growth = a new cast crept
+    into the step code — raise the budget deliberately or remove it)."""
+    from flaxdiff_tpu.analysis.programs import hot_programs
+    [(name, closed)] = hot_programs(["train_step_bf16"])
+    findings, stats = GRAPH_RULES["bf16-upcast"].check(name, closed)
+    assert findings == []
+    assert 0 < stats["elements"] <= framework.UPCAST_BUDGET[name]
+
+
+# -- budgets + report ---------------------------------------------------------
+
+def test_budget_semantics_over_under_and_slack():
+    f1 = Finding("r", "a.py", 1, "x")
+    f2 = Finding("r", "a.py", 2, "y")
+    # over budget: every finding in the file fails, budget in message
+    fails, notes = apply_budgets([f1, f2], {"r": {"a.py": 1}})
+    assert len(fails) == 2 and "budget 1" in fails[0].message
+    # at budget: pass, no note
+    fails, notes = apply_budgets([f1, f2], {"r": {"a.py": 2}})
+    assert fails == [] and notes == []
+    # under budget: pass + shrink note
+    fails, notes = apply_budgets([f1], {"r": {"a.py": 2}})
+    assert fails == [] and len(notes) == 1 and "shrink" in notes[0]
+    # stale budget (no findings at all): shrink note too
+    fails, notes = apply_budgets([], {"r": {"gone.py": 3}})
+    assert fails == [] and len(notes) == 1 and "gone.py" in notes[0]
+
+
+def test_custom_root_mode_scans_with_empty_allowlist(tmp_path):
+    bad = tmp_path / "offender.py"
+    bad.write_text("try:\n"
+                   "    risky()\n"
+                   "except Exception:\n"
+                   "    pass\n")
+    report = framework.run(rule_ids=["silent-except"],
+                           root=str(tmp_path), with_graph=False)
+    assert not report.ok
+    assert report.failures[0].file == "offender.py"
+    assert report.failures[0].line == 3
+
+
+def test_unknown_rule_id_rejected():
+    with pytest.raises(SystemExit, match="unknown rule"):
+        framework.run(rule_ids=["no-such-rule"], with_graph=False)
+
+
+def test_report_json_shape():
+    """The machine contract: version, ok, sorted findings with
+    over_budget flags, notes, graph stats — and no absolute paths."""
+    report = framework.run(rule_ids=["silent-except"], with_graph=False)
+    blob = framework.stable_json(report)
+    data = json.loads(blob)
+    assert data["version"] == 1 and data["ok"] is True
+    assert set(data) == {"version", "ok", "rules", "findings",
+                         "notes", "graph"}
+    assert "silent-except" in data["rules"]
+    assert "/root/" not in blob

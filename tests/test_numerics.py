@@ -495,3 +495,137 @@ class TestMemoryMonitor:
         assert isinstance(out, dict)
         if out:
             assert out["memory/bytes_in_use"] >= 0
+
+
+# -- per-module update-ratio z-scoring (ISSUE 9 satellite) ---------------------
+
+class TestModuleUpdateRatioZscore:
+    def test_single_module_spike_is_named_and_soft(self):
+        """One module's effective-LR running away fires an
+        `update_ratio_spike` naming THAT module; steady modules stay
+        silent; the spike is soft (never justifies rollback) and never
+        updates the module's EMA."""
+        det, hub, ev = _detector(min_steps=3, zscore=4.0, window=10)
+
+        def flat(ratio_b):
+            return {"numerics/loss": 1.0, "numerics/grad_norm": 1.0,
+                    "numerics/grad_nonfinite": 0.0,
+                    "numerics/module/enc/update_ratio": 1e-3,
+                    "numerics/module/dec/update_ratio": ratio_b}
+
+        for s in range(12):
+            assert det.observe_aux(s, flat(2e-3)) == []
+        out = det.observe_aux(12, flat(0.5))
+        assert [a.kind for a in out] == ["update_ratio_spike"]
+        assert out[0].metric == "module/dec/update_ratio"
+        assert not out[0].hard
+        assert ev.count("anomaly", "numerics.update_ratio_spike") == 1
+        assert hub.counter("numerics/anomalies").value == 1
+        # the spike stayed out of dec's EMA: normal values stay normal
+        assert det.observe_aux(13, flat(2e-3)) == []
+
+    def test_hard_anomaly_skips_module_pass(self):
+        """A gated/poisoned step's ratios are artifacts — they must not
+        teach the module EMAs (nor fire spikes of their own)."""
+        det, _, _ = _detector(min_steps=1, zscore=4.0)
+        bad = {"numerics/loss": float("nan"),
+               "numerics/grad_norm": 1.0,
+               "numerics/grad_nonfinite": 3.0,
+               "numerics/module/enc/update_ratio": 99.0}
+        out = det.observe_aux(1, bad)
+        assert all(a.hard for a in out)
+        assert det._mod_ratio == {}     # module EMAs never touched
+
+    def test_module_ratio_extraction(self):
+        flat = {"numerics/module/enc/update_ratio": 0.25,
+                "numerics/module/enc/grad_norm": 7.0,
+                "numerics/update_ratio": 0.5,
+                "numerics/loss": 1.0}
+        assert T.AnomalyDetector.module_update_ratios(flat) == {
+            "enc": 0.25}
+
+
+# -- per-leaf nonfinite-gate visibility counter (ISSUE 9 satellite) ------------
+
+def test_gate_counter_counts_masked_elements_in_graph(rng):
+    """With TrainState.gate_events carried, the elementwise gate
+    accumulates how many params/opt/EMA elements it masked — zero on a
+    healthy step, every element of the poisoned update on a NaN batch —
+    while the gating semantics stay bit-identical (state unchanged)."""
+    apply_fn, init_fn = _tiny_model()
+    step = make_train_step(
+        apply_fn, CosineNoiseSchedule(timesteps=100),
+        EpsilonPredictionTransform(), TrainStepConfig(normalize=False),
+        gate_nonfinite=True)
+    jitted = jax.jit(step)
+    tx = optax.adam(1e-3)
+    init_key, train_key = jax.random.split(jax.random.PRNGKey(0))
+    state0 = TrainState.create(apply_fn=apply_fn,
+                               params=init_fn(init_key), tx=tx,
+                               rng=train_key, gate_counter=True)
+    assert state0.gate_events.shape == (3,)
+    good = {"sample": rng.normal(size=(4, 8, 8, 1)).astype(np.float32)}
+    bad = {"sample": np.full((4, 8, 8, 1), np.nan, np.float32)}
+
+    state1, _ = jitted(state0, good)
+    counts1 = np.asarray(state1.gate_events)
+    assert counts1.sum() == 0
+
+    n_params = sum(int(np.asarray(l).size) for l in
+                   jax.tree_util.tree_leaves(state1.params))
+    state2, loss2 = jitted(state1, bad)
+    counts2 = np.asarray(state2.gate_events)
+    assert not np.isfinite(float(loss2))
+    # a NaN loss poisons every update element: params and EMA each count
+    # their full size, adam's m/v double it
+    assert counts2[0] == n_params and counts2[2] == n_params
+    assert counts2[1] == 2 * n_params
+    for a, b in zip(jax.tree_util.tree_leaves(state2.params),
+                    jax.tree_util.tree_leaves(state1.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # cumulative: a second poisoned step doubles the account
+    state3, _ = jitted(state2, bad)
+    assert np.asarray(state3.gate_events).sum() == 2 * counts2.sum()
+
+
+def test_gate_counter_counts_in_monitored_twin(rng):
+    """The monitored (cadence) program gates with the global verdict —
+    it must keep the SAME visibility account or cadence steps would be
+    a hole in the series."""
+    apply_fn, init_fn = _tiny_model()
+    step = make_train_step(
+        apply_fn, CosineNoiseSchedule(timesteps=100),
+        EpsilonPredictionTransform(), TrainStepConfig(normalize=False),
+        numerics=T.NumericsConfig(skip_nonfinite=True),
+        gate_nonfinite=True)
+    jitted = jax.jit(step)
+    tx = optax.adam(1e-3)
+    init_key, train_key = jax.random.split(jax.random.PRNGKey(0))
+    state0 = TrainState.create(apply_fn=apply_fn,
+                               params=init_fn(init_key), tx=tx,
+                               rng=train_key, gate_counter=True)
+    good = {"sample": rng.normal(size=(4, 8, 8, 1)).astype(np.float32)}
+    bad = {"sample": np.full((4, 8, 8, 1), np.nan, np.float32)}
+
+    state1, _, aux1 = jitted(state0, good)
+    assert np.asarray(state1.gate_events).sum() == 0
+    assert float(aux1["skipped"]) == 0.0
+
+    state2, _, aux2 = jitted(state1, bad)
+    assert float(aux2["skipped"]) == 1.0
+    assert np.asarray(state2.gate_events).sum() > 0
+
+
+def test_gate_counter_requires_gate_nonfinite(mesh):
+    import flax.linen as nn
+
+    with pytest.raises(ValueError, match="gate_counter"):
+        DiffusionTrainer(
+            apply_fn=lambda p, x, t, c: x,
+            init_fn=lambda k: {"w": jnp.zeros((2,))},
+            tx=optax.adam(1e-3),
+            schedule=CosineNoiseSchedule(timesteps=100),
+            transform=EpsilonPredictionTransform(), mesh=mesh,
+            config=TrainerConfig(gate_counter=True,
+                                 gate_nonfinite=False))

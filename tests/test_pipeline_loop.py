@@ -398,3 +398,45 @@ def test_fit_releases_shared_iterator_on_return(mesh, rng):
                    for t in threading.enumerate())
     batch = next(it)                  # no "generator already executing"
     assert batch["sample"].shape == (8, 8, 8, 1)
+
+
+# -- gate-activation visibility counter (ISSUE 9 satellite) --------------------
+
+def test_gate_counter_surfaces_window_deltas(mesh, rng):
+    """TrainerConfig.gate_counter end-to-end: a poisoned batch
+    mid-window increments the in-graph [3] counter, and the log-cadence
+    fetch surfaces the delta as `numerics/gate_activations*` counters
+    plus a `gate_activated` event — with zero extra syncs (the read
+    rides the settled window fetch)."""
+    from flaxdiff_tpu.resilience.events import EventLog, use_event_log
+
+    tel = T.Telemetry(enabled=False)
+    tr = _make_trainer(mesh, telemetry=tel, gate_counter=True,
+                       log_every=3, keep_best_state=False)
+    assert tr.state.gate_events is not None
+
+    def data():
+        i = 0
+        while True:
+            i += 1
+            if i == 2:      # mid-window: poisoned, masked, NOT fatal
+                yield {"sample": np.full((8, 8, 8, 1), np.nan,
+                                         np.float32)}
+            else:
+                yield {"sample": rng.normal(size=(8, 8, 8, 1))
+                       .astype(np.float32)}
+
+    log = EventLog("gate")
+    with use_event_log(log):
+        tr.fit(data(), total_steps=6)
+
+    snap = tel.registry.snapshot()
+    total = snap["numerics/gate_activations"]
+    assert total > 0
+    assert total == (snap["numerics/gate_activations/params"]
+                     + snap["numerics/gate_activations/opt_state"]
+                     + snap["numerics/gate_activations/ema"])
+    assert log.count("gate_activated") == 1
+    # the state the masked update left behind is finite by construction
+    assert all(np.isfinite(np.asarray(l)).all() for l in
+               jax.tree_util.tree_leaves(tr.state.params))

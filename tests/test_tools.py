@@ -141,95 +141,63 @@ def test_bench_sigterm_emits_final_line(tmp_path):
     assert "partial" not in final
 
 
-# -- silent-exception gate (scripts/check_bare_except.py) ---------------------
+# -- graph-hygiene analyzer (scripts/lint.py; ISSUE 9) ------------------------
+#
+# Per-rule true-positive fixtures live in tests/test_analysis.py; here
+# the tier-1 gate is ONE unified-CLI invocation over the whole repo —
+# every AST rule (silent-except, metric-name, host-sync, lane-slice)
+# AND the jaxpr analyzers over the real traced hot programs.
 
-def test_repo_has_no_new_silent_excepts():
-    """Tier-1 gate: a new `except Exception: pass` outside the
-    grandfathered allowlist fails the build — the observability layer's
-    worst enemy is a failure that leaves no trace."""
-    from scripts.check_bare_except import main
-    assert main([]) == 0
+def test_repo_lint_clean_unified(capsys):
+    """ISSUE 9 acceptance: `scripts/lint.py` exits 0 on the repo with
+    an EMPTY silent-except allowlist, and the jaxpr analyzers report
+    zero RNG-reuse / callback findings on the real train-step and
+    sampler chunk programs."""
+    from scripts.lint import main
+    assert main(["--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["ok"] is True
+    assert not any(f["over_budget"] for f in data["findings"])
+    # the silent-except debt is GONE — nothing grandfathered
+    assert not any(f["rule"] == "silent-except"
+                   for f in data["findings"])
+    graph = data["graph"]
+    for prog in ("train_step", "train_step_monitored", "chunk_ddim",
+                 "chunk_euler_ancestral"):
+        assert graph[prog]["rng-key-reuse"]["reused"] == 0, prog
+        assert graph[prog]["callback-leak"]["callbacks"] == 0, prog
 
 
-def test_bare_except_gate_flags_new_offender(tmp_path, capsys):
+def test_lint_json_output_is_stable(capsys):
+    """--json is for machines: two runs on an unchanged tree must be
+    byte-identical (sorted findings, no timestamps, no abs paths)."""
+    from scripts.lint import main
+    assert main(["--json", "--no-graph"]) == 0
+    first = capsys.readouterr().out
+    assert main(["--json", "--no-graph"]) == 0
+    assert capsys.readouterr().out == first
+    json.loads(first)       # and it parses
+
+
+def test_legacy_shims_still_gate(tmp_path, capsys):
+    """The old standalone gates are thin shims over the unified rules:
+    same flags, same verdicts."""
     bad = tmp_path / "offender.py"
-    bad.write_text(
-        "def f():\n"
-        "    try:\n"
-        "        risky()\n"
-        "    except Exception:\n"
-        "        pass\n"
-        "    try:\n"
-        "        risky()\n"
-        "    except (ValueError, BaseException):\n"
-        "        ...\n")
-    from scripts.check_bare_except import main
-    assert main(["--root", str(bad)]) == 1
-    err = capsys.readouterr().err
-    assert "offender.py:4" in err and "offender.py:8" in err
+    bad.write_text("try:\n"
+                   "    risky()\n"
+                   "except Exception:\n"
+                   "    pass\n")
+    from scripts.check_bare_except import main as bare_main
+    assert bare_main(["--root", str(bad)]) == 1
+    assert "offender.py:3" in capsys.readouterr().err
 
-
-def test_bare_except_gate_accepts_handlers_that_act(tmp_path):
-    """Handlers that log, record, re-raise, or return a fallback are
-    NOT silent — only do-nothing bodies fail."""
-    ok = tmp_path / "fine.py"
-    ok.write_text(
-        "def f():\n"
-        "    try:\n"
-        "        risky()\n"
-        "    except Exception as e:\n"
-        "        record_event('x', 'y', detail=repr(e))\n"
-        "    try:\n"
-        "        risky()\n"
-        "    except ValueError:\n"     # narrow catch: allowed even silent
-        "        pass\n"
-        "    try:\n"
-        "        risky()\n"
-        "    except Exception:\n"
-        "        raise RuntimeError('context')\n")
-    from scripts.check_bare_except import main
-    assert main(["--root", str(ok)]) == 0
-
-
-# -- metric-name gate (scripts/check_metric_names.py) -------------------------
-
-def test_repo_metric_names_all_documented():
-    """Tier-1 gate: every metric name emitted in flaxdiff_tpu/ appears
-    in the docs/OBSERVABILITY.md reference table — an undocumented
-    series is half-observability."""
-    from scripts.check_metric_names import main
-    assert main([]) == 0
-
-
-def test_metric_gate_flags_undocumented_name(tmp_path, capsys):
     code = tmp_path / "emitter.py"
-    code.write_text(
-        "def f(reg):\n"
-        "    reg.counter('secret/undocumented').inc()\n"
-        "    reg.gauge('train/loss').set(1.0)\n")
+    code.write_text("def f(reg):\n"
+                    "    reg.counter('secret/undocumented').inc()\n"
+                    "    reg.gauge('train/loss').set(1.0)\n")
     docs = tmp_path / "docs.md"
     docs.write_text("| `train/loss` | gauge | documented |\n")
-    from scripts.check_metric_names import main
-    assert main(["--root", str(code), "--docs", str(docs)]) == 1
+    from scripts.check_metric_names import main as metric_main
+    assert metric_main(["--root", str(code), "--docs", str(docs)]) == 1
     err = capsys.readouterr().err
     assert "secret/undocumented" in err and "train/loss" not in err
-
-
-def test_metric_gate_wildcards_cover_fstrings_and_placeholders(tmp_path):
-    """f-string emissions match docs entries with <placeholder>
-    segments; exact names match either way; variable-name emissions
-    are invisible (documented by hand)."""
-    code = tmp_path / "emitter.py"
-    code.write_text(
-        "def f(reg, name):\n"
-        "    reg.histogram(f'phase/{name}').observe(0.1)\n"
-        "    reg.gauge('numerics/module/Conv_0/grad_norm').set(1.0)\n"
-        "    reg.gauge(name).set(1.0)\n")       # variable: ungated
-    docs = tmp_path / "docs.md"
-    docs.write_text("- `phase/<name>` histograms\n"
-                    "- `numerics/module/<module>/<stat>` rows\n")
-    from scripts.check_metric_names import main
-    assert main(["--root", str(code), "--docs", str(docs)]) == 0
-    # remove the wildcard: the f-string prefix is now undocumented
-    docs.write_text("- `numerics/module/<module>/<stat>` rows\n")
-    assert main(["--root", str(code), "--docs", str(docs)]) == 1

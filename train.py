@@ -138,6 +138,16 @@ def parse_args(argv=None):
                         "the live state is finite by construction); "
                         "disabling restores the legacy synchronous "
                         "save-cadence loss check")
+    p.add_argument("--gate_counter", action="store_true",
+                   help="carry an in-graph [3] int32 counter of the "
+                        "elements the non-finite gate masked in "
+                        "params/opt-state/EMA, surfaced once per log "
+                        "window as numerics/gate_activations* counters "
+                        "+ a gate_activated event. Opt-in: the count "
+                        "reduces over every state leaf (slower XLA "
+                        "compile) and adds a checkpoint pytree leaf — "
+                        "flip per run, not mid-run. Requires the gate "
+                        "(incompatible with --no_nonfinite_gate)")
     p.add_argument("--flash_tune_cache", default=None,
                    help="per-shape flash-attention autotuner cache dir "
                         "(ops/autotune.py): before the first step, a "
@@ -603,6 +613,7 @@ def main(argv=None):
                              telemetry_sample_every=(
                                  args.telemetry_sample_every),
                              gate_nonfinite=not args.no_nonfinite_gate,
+                             gate_counter=args.gate_counter,
                              loss_ring=args.loss_ring),
         policy=policy, null_cond=null_cond, checkpointer=ckpt,
         autoencoder=autoencoder, telemetry=telemetry)
