@@ -29,10 +29,28 @@ def _tiny_model():
     import flax.linen as nn
 
     class Tiny(nn.Module):
+        # implements the diffusion-cache `cache_mode` forward contract
+        # (ops/diffcache.py) so the cached sampler programs can be
+        # traced around the same tiny backbone: the first conv is the
+        # always-run shallow part, the middle conv the cached deep
+        # delta
+
         @nn.compact
-        def __call__(self, x, t, cond=None):
-            h = nn.Conv(8, (3, 3))(x)
-            return nn.Conv(x.shape[-1], (3, 3))(jnp.tanh(h))
+        def __call__(self, x, t, cond=None, cache_mode=None,
+                     cache_taps=None):
+            # explicit names: the reuse path skips the deep conv, so
+            # compact auto-numbering would shift the tail conv's name
+            h = nn.Conv(8, (3, 3), name="shallow")(x)
+            if cache_mode == "reuse":
+                h = h + cache_taps
+                taps = cache_taps
+            else:
+                taps = nn.Conv(8, (3, 3), name="deep")(jnp.tanh(h))
+                h = h + taps
+            out = nn.Conv(x.shape[-1], (3, 3), name="tail")(jnp.tanh(h))
+            if cache_mode == "record":
+                return out, taps
+            return out
 
     model = Tiny()
 
@@ -43,7 +61,15 @@ def _tiny_model():
         return model.init(key, jnp.zeros((1, 8, 8, 1)),
                           jnp.zeros((1,)))["params"]
 
-    return apply_fn, init_fn
+    def record_fn(params, x, t, cond):
+        return model.apply({"params": params}, x, t, None,
+                           cache_mode="record")
+
+    def reuse_fn(params, x, t, cond, taps):
+        return model.apply({"params": params}, x, t, None,
+                           cache_mode="reuse", cache_taps=taps)
+
+    return apply_fn, init_fn, (record_fn, reuse_fn)
 
 
 @functools.lru_cache(maxsize=None)
@@ -54,7 +80,7 @@ def _train_pieces():
     from ..schedulers import CosineNoiseSchedule
     from ..trainer.train_state import TrainState
 
-    apply_fn, init_fn = _tiny_model()
+    apply_fn, init_fn, _ = _tiny_model()
     key = jax.random.PRNGKey(0)
     init_key, train_key = jax.random.split(key)
     state = TrainState.create(apply_fn=apply_fn,
@@ -84,20 +110,25 @@ def train_step_jaxpr(monitored: bool = False, bf16: bool = False):
 
 
 @functools.lru_cache(maxsize=None)
-def _sampler_pieces(sampler_name: str):
+def _sampler_pieces(sampler_name: str, cached: bool = False):
+    from ..ops.diffcache import CachePlan
     from ..predictors import EpsilonPredictionTransform
     from ..samplers import SAMPLER_REGISTRY, DiffusionSampler
     from ..schedulers import CosineNoiseSchedule
 
     apply_fn, state, _, _, _ = _train_pieces()
+    _, _, cache_fns = _tiny_model()
     params = state.params
 
     def model_fn(p, x, t, cond):
         return apply_fn(p, x, t, cond)
 
-    ds = DiffusionSampler(model_fn, CosineNoiseSchedule(timesteps=100),
-                          EpsilonPredictionTransform(),
-                          SAMPLER_REGISTRY[sampler_name]())
+    ds = DiffusionSampler(
+        model_fn, CosineNoiseSchedule(timesteps=100),
+        EpsilonPredictionTransform(),
+        SAMPLER_REGISTRY[sampler_name](),
+        cache_plan=CachePlan(refresh_every=2) if cached else None,
+        cache_fns=cache_fns if cached else None)
     return ds, params
 
 
@@ -131,14 +162,40 @@ def terminal_program_jaxpr(sampler_name: str, rows: int = 2):
     return jax.make_jaxpr(prog)(params, x, t_term, None, None)
 
 
-def solo_program_jaxpr(sampler_name: str = "ddim", steps: int = 4):
-    """The solo single-scan trajectory program generate_samples runs."""
-    ds, params = _sampler_pieces(sampler_name)
+def solo_program_jaxpr(sampler_name: str = "ddim", steps: int = 4,
+                       cached: bool = False):
+    """The solo single-scan trajectory program generate_samples runs;
+    with `cached`, the diffusion-cache variant (taps carry + per-step
+    `lax.cond` refresh gating, ops/diffcache.py)."""
+    ds, params = _sampler_pieces(sampler_name, cached=cached)
     shape = (2, 8, 8, 1)
     prog = ds._get_program(steps, shape, None, 0.0)
     x = jnp.zeros(shape, jnp.float32)
     key = jax.random.PRNGKey(0)
     return jax.make_jaxpr(prog)(params, x, key, None, None)
+
+
+def cached_chunk_program_jaxpr(sampler_name: str = "ddim",
+                               rows: int = 2, round_steps: int = 2):
+    """The serving layer's cached continuous-batching round
+    (`make_cached_chunk_program`) with the exact input layout
+    `SamplerProgramEngine.advance` feeds it on the cached path:
+    round-level refresh flags + per-row taps carries."""
+    ds, params = _sampler_pieces(sampler_name, cached=True)
+    prog = ds.make_cached_chunk_program(round_steps)
+    x = jnp.zeros((rows, 1, 8, 8, 1), jnp.float32)
+    keys = jnp.stack([jax.random.PRNGKey(i) for i in range(rows)])
+    pairs = jnp.zeros((rows, round_steps, 2), jnp.float32)
+    n_act = jnp.zeros((rows,), jnp.int32)
+    offsets = jnp.zeros((rows,), jnp.int32)
+    row_states = [ds.sampler.init_state(
+        jnp.zeros((1, 8, 8, 1), jnp.float32)) for _ in range(rows)]
+    state = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                   *row_states)
+    flags = jnp.zeros((round_steps,), bool)
+    taps = jnp.zeros((rows, 1, 8, 8, 8), jnp.float32)
+    return jax.make_jaxpr(prog)(params, x, keys, pairs, n_act, offsets,
+                                None, None, state, flags, taps)
 
 
 # the inventory the CLI and the tier-1 clean-pass tests iterate
@@ -149,8 +206,13 @@ PROGRAM_BUILDERS = {
     "chunk_ddim": lambda: chunk_program_jaxpr("ddim"),
     "chunk_euler_ancestral":
         lambda: chunk_program_jaxpr("euler_ancestral"),
+    "chunk_ddim_cached": lambda: cached_chunk_program_jaxpr("ddim"),
+    "chunk_euler_ancestral_cached":
+        lambda: cached_chunk_program_jaxpr("euler_ancestral"),
     "terminal_ddim": lambda: terminal_program_jaxpr("ddim"),
     "solo_ddim": lambda: solo_program_jaxpr("ddim"),
+    "solo_ddim_cached":
+        lambda: solo_program_jaxpr("ddim", cached=True),
 }
 
 

@@ -195,7 +195,13 @@ class DiffusionInferencePipeline:
 
     # -- sampling ------------------------------------------------------------
     def get_sampler(self, sampler: str | Sampler | Type[Sampler] = "ddim",
-                    guidance_scale: float = 0.0) -> DiffusionSampler:
+                    guidance_scale: float = 0.0,
+                    cache_plan=None) -> DiffusionSampler:
+        """`cache_plan` (ops.diffcache.CachePlan) activates the
+        training-free activation cache (docs/CACHING.md). The plan is
+        folded into the sampler cache key — two plans never share a
+        compiled DiffusionSampler, mirroring the DDIM-eta key rule."""
+        from ..ops.diffcache import active_plan, resolve_cache_fns
         if isinstance(sampler, str):
             if sampler not in SAMPLER_REGISTRY:
                 raise ValueError(f"unknown sampler {sampler!r}")
@@ -204,14 +210,19 @@ class DiffusionInferencePipeline:
             sampler_obj = sampler()
         else:
             sampler_obj = sampler
-        key = _sampler_cache_key(sampler_obj, guidance_scale)
+        plan = active_plan(cache_plan)
+        key = _sampler_cache_key(sampler_obj, guidance_scale) \
+            + (plan.key() if plan is not None else None,)
         if key not in self._sampler_cache:
+            cache_fns = (resolve_cache_fns(self.model, plan)
+                         if plan is not None else None)
             self._sampler_cache[key] = DiffusionSampler(
                 model_fn=lambda p, x, t, c: self.model.apply(p, x, t, c),
                 schedule=self.schedule, transform=self.transform,
                 autoencoder=self.autoencoder,
                 guidance_scale=guidance_scale,
-                sampler=sampler_obj)
+                sampler=sampler_obj,
+                cache_plan=plan, cache_fns=cache_fns)
         return self._sampler_cache[key]
 
     def generate_samples(self,
@@ -226,10 +237,13 @@ class DiffusionInferencePipeline:
                          sequence_length: Optional[int] = None,
                          channels: int = 3,
                          inpaint_reference=None,
-                         inpaint_mask=None) -> np.ndarray:
+                         inpaint_mask=None,
+                         cache_plan=None) -> np.ndarray:
         """Generate images/videos; prompts are encoded through the input
         config when given (reference pipeline.py:217-272). Inpainting:
-        see DiffusionSampler.generate_samples."""
+        see DiffusionSampler.generate_samples. `cache_plan` activates
+        the training-free activation cache for this trajectory
+        (docs/CACHING.md); None keeps the bit-exact uncached path."""
         params = (self.ema_params
                   if use_ema and self.ema_params is not None else self.params)
         conditioning = unconditional = None
@@ -250,9 +264,19 @@ class DiffusionInferencePipeline:
             # whether context is present, e.g. Unet's mid block).
             conditioning = self.input_config.get_unconditionals(
                 batch_size=num_samples)[0]
-        ds = self.get_sampler(sampler, guidance_scale)
+        ds = self.get_sampler(sampler, guidance_scale,
+                              cache_plan=cache_plan)
         from ..telemetry import global_telemetry
         tel = global_telemetry()
+        if ds.cache_active:
+            # plan accounting is pure host arithmetic on the static
+            # schedule — no device syncs
+            flags = ds.cache_plan.flags(diffusion_steps)
+            tel.counter("diffcache/requests").inc()
+            tel.counter("diffcache/refresh_steps").inc(
+                int(flags.sum()))
+            tel.counter("diffcache/reused_steps").inc(
+                int((~flags).sum()))
         sampler_name = (sampler if isinstance(sampler, str)
                         else type(ds.sampler).__name__)
         import time as _time

@@ -190,10 +190,47 @@ class SimpleDiT(nn.Module):
                                   self.output_channels)
         return unpatchify(tokens, p, height, width, self.output_channels)
 
+    def cache_split_index(self, depth_fraction: float) -> int:
+        """Trunk split for the training-free diffusion cache
+        (ops/diffcache.py): blocks `[0, split)` are the always-run
+        shallow part, `[split, num_layers)` the cached deep trunk."""
+        if self.num_layers < 2:
+            raise ValueError(
+                "diffusion cache needs num_layers >= 2 (no deep trunk "
+                "to cache below that)")
+        return max(1, min(self.num_layers - 1,
+                          round(self.num_layers * depth_fraction)))
+
     def __call__(self, x: jax.Array, temb: jax.Array,
-                 textcontext: Optional[jax.Array] = None) -> jax.Array:
+                 textcontext: Optional[jax.Array] = None,
+                 cache_mode: Optional[str] = None,
+                 cache_split: int = 0,
+                 cache_taps: Optional[jax.Array] = None) -> jax.Array:
         B, H, W, C = x.shape
         tokens, cond, freqs, inv_idx = self.head(x, temb, textcontext)
-        for block in self.blocks:
+        if cache_mode is None:
+            for block in self.blocks:
+                tokens = block(tokens, cond, freqs)
+            return self.tail(tokens, inv_idx, H, W)
+        # Training-free diffusion cache forward (ops/diffcache.py,
+        # docs/CACHING.md). "record" runs the EXACT same block sequence
+        # as the plain path (bit-identical output, tested) and
+        # additionally returns the deep trunk's residual delta;
+        # "reuse" re-centers a previously recorded delta on the fresh
+        # shallow activations instead of running the deep blocks.
+        split = int(cache_split)
+        if not 0 < split < self.num_layers:
+            raise ValueError(f"cache_split {split} out of range for "
+                             f"{self.num_layers} blocks")
+        for block in self.blocks[:split]:
             tokens = block(tokens, cond, freqs)
-        return self.tail(tokens, inv_idx, H, W)
+        if cache_mode == "record":
+            deep = tokens
+            for block in self.blocks[split:]:
+                deep = block(deep, cond, freqs)
+            return self.tail(deep, inv_idx, H, W), deep - tokens
+        if cache_mode == "reuse":
+            if cache_taps is None:
+                raise ValueError("cache_mode='reuse' requires cache_taps")
+            return self.tail(tokens + cache_taps, inv_idx, H, W)
+        raise ValueError(f"unknown cache_mode {cache_mode!r}")

@@ -137,9 +137,23 @@ class SimpleMMDiT(nn.Module):
     activation: Callable = jax.nn.gelu
     fused_epilogues: bool = True
 
+    def cache_split_index(self, depth_fraction: float) -> int:
+        """Trunk split for the diffusion cache (ops/diffcache.py) —
+        same semantics as SimpleDiT: `[0, split)` always runs,
+        `[split, num_layers)` is the cached deep trunk."""
+        if self.num_layers < 2:
+            raise ValueError(
+                "diffusion cache needs num_layers >= 2 (no deep trunk "
+                "to cache below that)")
+        return max(1, min(self.num_layers - 1,
+                          round(self.num_layers * depth_fraction)))
+
     @nn.compact
     def __call__(self, x: jax.Array, temb: jax.Array,
-                 textcontext: jax.Array) -> jax.Array:
+                 textcontext: jax.Array,
+                 cache_mode: Optional[str] = None,
+                 cache_split: int = 0,
+                 cache_taps: Optional[jax.Array] = None) -> jax.Array:
         if textcontext is None:
             raise ValueError("SimpleMMDiT requires textcontext")
         B, H, W, C = x.shape
@@ -168,15 +182,45 @@ class SimpleMMDiT(nn.Module):
 
         freqs = rope_frequencies(self.emb_features // self.num_heads,
                                  tokens.shape[1])
-        for i in range(self.num_layers):
-            tokens = MMDiTBlock(
+
+        def run_block(i, h):
+            return MMDiTBlock(
                 features=self.emb_features, num_heads=self.num_heads,
                 mlp_ratio=self.mlp_ratio, backend=self.backend,
                 dtype=self.dtype, precision=self.precision,
                 force_fp32_for_softmax=self.force_fp32_for_softmax,
                 norm_epsilon=self.norm_epsilon, activation=self.activation,
                 fused_epilogues=self.fused_epilogues,
-                name=f"block_{i}")(tokens, t_emb, text_emb, freqs)
+                name=f"block_{i}")(h, t_emb, text_emb, freqs)
+
+        taps = None
+        if cache_mode is None:
+            for i in range(self.num_layers):
+                tokens = run_block(i, tokens)
+        else:
+            # diffusion-cache forward (ops/diffcache.py): "record" runs
+            # the exact plain block sequence + returns the deep delta;
+            # "reuse" re-centers the cached delta on fresh shallow
+            # activations instead of running the deep blocks.
+            split = int(cache_split)
+            if not 0 < split < self.num_layers:
+                raise ValueError(f"cache_split {split} out of range "
+                                 f"for {self.num_layers} blocks")
+            for i in range(split):
+                tokens = run_block(i, tokens)
+            if cache_mode == "record":
+                deep = tokens
+                for i in range(split, self.num_layers):
+                    deep = run_block(i, deep)
+                taps = deep - tokens
+                tokens = deep
+            elif cache_mode == "reuse":
+                if cache_taps is None:
+                    raise ValueError(
+                        "cache_mode='reuse' requires cache_taps")
+                tokens = tokens + cache_taps
+            else:
+                raise ValueError(f"unknown cache_mode {cache_mode!r}")
 
         tokens = nn.LayerNorm(epsilon=self.norm_epsilon, dtype=jnp.float32,
                               name="final_norm")(tokens)
@@ -187,8 +231,13 @@ class SimpleMMDiT(nn.Module):
         if self.learn_sigma:
             tokens, _ = jnp.split(tokens, 2, axis=-1)
         if inv_idx is not None:
-            return sfc_unpatchify(tokens, inv_idx, p, H, W, self.output_channels)
-        return unpatchify(tokens, p, H, W, self.output_channels)
+            out = sfc_unpatchify(tokens, inv_idx, p, H, W,
+                                 self.output_channels)
+        else:
+            out = unpatchify(tokens, p, H, W, self.output_channels)
+        if cache_mode == "record":
+            return out, taps
+        return out
 
 
 class PatchMerging(nn.Module):

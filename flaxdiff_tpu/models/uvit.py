@@ -158,13 +158,32 @@ class SimpleUDiT(nn.Module):
     use_zigzag: bool = False
     fused_epilogues: bool = True
 
+    def cache_split_index(self, depth_fraction: float) -> int:
+        """U-shape split for the diffusion cache (ops/diffcache.py):
+        the outer `s` down blocks and the matching last `s` up blocks
+        always run (2s of num_layers+1 blocks ~= depth_fraction); the
+        inner downs + mid + inner ups form the cached core. The outer
+        skips stay exact because their down blocks re-run every step."""
+        half = self.num_layers // 2
+        if half < 2:
+            raise ValueError(
+                "diffusion cache needs num_layers >= 4 on the U shape "
+                "(no inner core to cache below that)")
+        s = round(depth_fraction * (self.num_layers + 1) / 2.0)
+        return max(1, min(half - 1, s))
+
     @nn.compact
     def __call__(self, x: jax.Array, temb: jax.Array,
-                 textcontext: Optional[jax.Array] = None) -> jax.Array:
+                 textcontext: Optional[jax.Array] = None,
+                 cache_mode: Optional[str] = None,
+                 cache_split: int = 0,
+                 cache_taps: Optional[jax.Array] = None) -> jax.Array:
         if self.num_layers % 2:
             raise ValueError("num_layers must be even for the U structure")
         if self.use_hilbert and self.use_zigzag:
             raise ValueError("use_hilbert and use_zigzag are mutually exclusive")
+        if cache_mode not in (None, "record", "reuse"):
+            raise ValueError(f"unknown cache_mode {cache_mode!r}")
         B, H, W, C = x.shape
         p = self.patch_size
         num_patches = (H // p) * (W // p)
@@ -190,23 +209,49 @@ class SimpleUDiT(nn.Module):
             norm_epsilon=self.norm_epsilon,
             fused_epilogues=self.fused_epilogues, name=name)
 
-        half = self.num_layers // 2
-        skips = []
-        h = tokens
-        for i in range(half):
-            h = block(f"down_{i}")(h, cond, freqs)
-            skips.append(h)
-        h = block("mid")(h, cond, freqs)
-        for i in range(half):
+        def up(i, h):
             h = jnp.concatenate([h, skips.pop()], axis=-1)
             h = nn.Dense(self.emb_features, dtype=self.dtype,
                          precision=self.precision, name=f"up_fuse_{i}")(h)
-            h = block(f"up_{i}")(h, cond, freqs)
+            return block(f"up_{i}")(h, cond, freqs)
+
+        half = self.num_layers // 2
+        s = half if cache_mode is None else int(cache_split)
+        if cache_mode is not None and not 0 < s < half:
+            raise ValueError(f"cache_split {s} out of range for "
+                             f"{self.num_layers} U layers")
+        skips = []
+        taps = None
+        h = tokens
+        for i in range(s):                       # outer downs (always)
+            h = block(f"down_{i}")(h, cond, freqs)
+            skips.append(h)
+        if cache_mode == "reuse":
+            if cache_taps is None:
+                raise ValueError("cache_mode='reuse' requires cache_taps")
+            h = h + cache_taps                   # re-centered core delta
+        else:
+            # plain (s == half: the loops below cover the whole U) and
+            # "record" both run the EXACT original block sequence
+            core_in = h
+            for i in range(s, half):             # inner downs
+                h = block(f"down_{i}")(h, cond, freqs)
+                skips.append(h)
+            h = block("mid")(h, cond, freqs)
+            for i in range(half - s):            # inner ups
+                h = up(i, h)
+            taps = h - core_in
+        for i in range(half - s, half):          # outer ups (always)
+            h = up(i, h)
 
         h = nn.LayerNorm(epsilon=self.norm_epsilon, dtype=jnp.float32,
                          name="final_norm")(h)
         h = nn.Dense(p * p * self.output_channels, dtype=jnp.float32,
                      kernel_init=nn.initializers.zeros, name="final_proj")(h)
         if inv_idx is not None:
-            return sfc_unpatchify(h, inv_idx, p, H, W, self.output_channels)
-        return unpatchify(h, p, H, W, self.output_channels)
+            out = sfc_unpatchify(h, inv_idx, p, H, W, self.output_channels)
+        else:
+            out = unpatchify(h, p, H, W, self.output_channels)
+        if cache_mode == "record":
+            return out, taps
+        return out

@@ -1,3 +1,4 @@
 """First-party TPU ops: Pallas kernels with XLA fallbacks."""
 from .attention import dot_product_attention, attention_backend_available
+from .diffcache import CachePlan, DEFAULT_CACHE_PLAN
 from .fused_norm import fused_groupnorm_silu

@@ -75,6 +75,12 @@ def get_timestep_spacing(method: str, num_steps: int, timesteps: int,
                                   num_steps + 1)) ** rho - 1.0
     else:
         raise ValueError(f"Unknown timestep spacing {method!r}")
+    # Pin the endpoints analytically: the nonlinear spacings round-trip
+    # hi/lo through f32 powers/logs (and the karras sigma inverse), so
+    # the first value can drift ABOVE the schedule domain (999.0002 for
+    # timesteps=1000) and the terminal can miss `end` — at few-step
+    # trajectories (num_steps 1-3) that drift is the whole step budget.
+    steps = steps.at[0].set(hi).at[-1].set(lo)
     return steps
 
 
@@ -124,7 +130,9 @@ class DiffusionSampler:
                  guidance_scale: float = 0.0,
                  autoencoder: Optional[Any] = None,
                  clip_denoised: bool = False,
-                 timestep_spacing: str = "linear"):
+                 timestep_spacing: str = "linear",
+                 cache_plan: Optional[Any] = None,
+                 cache_fns: Optional[Tuple[Callable, Callable]] = None):
         self.model_fn = model_fn
         self.schedule = schedule
         self.transform = transform
@@ -133,7 +141,21 @@ class DiffusionSampler:
         self.autoencoder = autoencoder
         self.clip_denoised = clip_denoised
         self.timestep_spacing = timestep_spacing
+        # training-free diffusion cache (ops/diffcache.py,
+        # docs/CACHING.md): a static CachePlan plus the model's
+        # (record_fn, reuse_fn) cache_mode closures. Both must be
+        # present for the cached programs to build; otherwise every
+        # program below is byte-for-byte the pre-cache one.
+        self.cache_plan = cache_plan
+        self.cache_fns = cache_fns
         self._compiled = {}
+        self._taps_specs = {}
+
+    @property
+    def cache_active(self) -> bool:
+        return (self.cache_plan is not None
+                and getattr(self.cache_plan, "enabled", False)
+                and self.cache_fns is not None)
 
     # -- model evaluation with CFG ------------------------------------------
     def _denoise_fn(self, params, cond, uncond):
@@ -167,29 +189,155 @@ class DiffusionSampler:
 
         return denoise
 
+    # -- cached model evaluation (training-free diffusion cache) ------------
+    def _denoise_taps_mode_fn(self, params, cond, uncond, mode: str):
+        """`denoise(x, t, taps) -> (x0, eps, taps_out)` for ONE cache
+        mode — "record" (full evaluation, fresh taps) or "reuse"
+        (shallow-only, cached taps re-centered). The pre/post transform
+        math mirrors `_denoise_fn` exactly so a record-every-step plan
+        is bit-identical to the uncached path (tested)."""
+        schedule, transform = self.schedule, self.transform
+        record_fn, reuse_fn = self.cache_fns
+        use_cfg = self.guidance_scale > 0.0 and uncond is not None
+
+        def denoise(x, t, taps):
+            t_b = jnp.broadcast_to(t, (x.shape[0],)).astype(jnp.float32)
+            c_in = bcast_right(transform.input_scale(schedule, t_b), x.ndim)
+            x_in, t_in = schedule.transform_inputs(x * c_in, t_b)
+            if use_cfg:
+                x_net = jnp.concatenate([x_in, x_in], axis=0)
+                t_net = jnp.concatenate([t_in, t_in], axis=0)
+                c_net = jax.tree_util.tree_map(
+                    lambda c, u: jnp.concatenate([c, u], axis=0),
+                    cond, uncond)
+            else:
+                x_net, t_net, c_net = x_in, t_in, cond
+            if mode == "record":
+                raw, taps = record_fn(params, x_net, t_net, c_net)
+            else:
+                raw = reuse_fn(params, x_net, t_net, c_net, taps)
+            if use_cfg:
+                raw_c, raw_u = jnp.split(raw, 2, axis=0)
+                raw = raw_u + self.guidance_scale * (raw_c - raw_u)
+            pred = transform.transform_output(x, t_b,
+                                              raw.astype(jnp.float32),
+                                              schedule)
+            x0, eps = transform.to_x0_eps(x, t_b, pred, schedule)
+            if self.clip_denoised:
+                x0 = clip_images(x0)
+                _, sigma = schedule.rates(t_b)
+                signal, _ = schedule.rates(t_b)
+                eps = (x - bcast_right(signal, x.ndim) * x0) / jnp.maximum(
+                    bcast_right(sigma, x.ndim), 1e-12)
+            return x0, eps, taps
+
+        return denoise
+
+    def _denoise_taps_fn(self, params, cond, uncond):
+        """`denoise(x, t, taps, refresh) -> (x0, eps, taps)`: a scalar
+        `lax.cond` between the record and reuse modes. The predicate is
+        always a per-STEP scalar (solo scan input / round-level serving
+        flag), never batched — a vmapped cond degenerates to select and
+        would execute BOTH branches, erasing the speedup."""
+        record = self._denoise_taps_mode_fn(params, cond, uncond, "record")
+        reuse = self._denoise_taps_mode_fn(params, cond, uncond, "reuse")
+
+        def denoise(x, t, taps, refresh):
+            return jax.lax.cond(refresh, record, reuse, x, t, taps)
+
+        return denoise
+
+    def cache_taps_init(self, params, x, cond, uncond):
+        """Zero-filled cache carry shaped like the record branch's taps
+        output (CFG doubles the batch the taps cover). `jax.eval_shape`
+        only — no device compute — and the resulting spec is memoized
+        per input-shape signature: the abstract model trace costs tens
+        of ms, which must not recur on every serving admission (it
+        would serialize the dispatch loop)."""
+        def sig(v):
+            return tuple(jax.tree_util.tree_flatten(
+                jax.tree_util.tree_map(
+                    lambda a: (tuple(a.shape), str(a.dtype)), v))[0])
+
+        spec_key = (sig(x), sig(cond), sig(uncond))
+        spec = self._taps_specs.get(spec_key)
+        if spec is not None:
+            return jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype), spec)
+        record_fn, _ = self.cache_fns
+        schedule, transform = self.schedule, self.transform
+        use_cfg = self.guidance_scale > 0.0 and uncond is not None
+
+        def probe(x):
+            t_b = jnp.zeros((x.shape[0],), jnp.float32)
+            c_in = bcast_right(transform.input_scale(schedule, t_b), x.ndim)
+            x_in, t_in = schedule.transform_inputs(x * c_in, t_b)
+            if use_cfg:
+                x_in = jnp.concatenate([x_in, x_in], axis=0)
+                t_in = jnp.concatenate([t_in, t_in], axis=0)
+                c = jax.tree_util.tree_map(
+                    lambda c_, u_: jnp.concatenate([c_, u_], axis=0),
+                    cond, uncond)
+            else:
+                c = cond
+            _, taps = record_fn(params, x_in, t_in, c)
+            return taps
+
+        spec = jax.eval_shape(probe, x)
+        self._taps_specs[spec_key] = spec
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), spec)
+
     # -- one compiled program per (steps, shape) ----------------------------
     def _get_program(self, num_steps: int, shape: Tuple[int, ...],
                      start: Optional[float], end: float,
                      inpaint: bool = False):
-        cache_key = (num_steps, shape, start, end, inpaint)
+        cached = self.cache_active
+        plan_key = self.cache_plan.key() if cached else None
+        cache_key = (num_steps, shape, start, end, inpaint, plan_key)
         if cache_key in self._compiled:
             return self._compiled[cache_key]
 
         steps = get_timestep_spacing(self.timestep_spacing, num_steps,
                                      self.schedule.timesteps, start, end,
                                      schedule=self.schedule)
+        # static per-step refresh schedule, folded into the scan as an
+        # input row; with the cache off this is absent and the program
+        # below is byte-for-byte the pre-cache one
+        flags = jnp.asarray(self.cache_plan.flags(num_steps)) \
+            if cached else None
 
         def program(params, x_init, key, cond, uncond, mask=None, known=None):
             denoise = self._denoise_fn(params, cond, uncond)
+            if cached:
+                denoise_taps = self._denoise_taps_fn(params, cond, uncond)
             pairs = jnp.stack([steps[:-1], steps[1:]], axis=1)
 
             def scan_step(carry, inp):
-                x, rng, state = carry
-                pair, idx = inp
+                if cached:
+                    x, rng, state, taps = carry
+                    pair, idx, refresh = inp
+                    # higher-order samplers call denoise several times
+                    # per step; the box threads the taps carry through
+                    # every call (each full eval re-records, each
+                    # cached eval reuses — all under the one per-step
+                    # scalar cond)
+                    taps_box = [taps]
+
+                    def step_denoise(x_, t_):
+                        x0, eps, tp = denoise_taps(
+                            x_, t_, taps_box[0], refresh)
+                        taps_box[0] = tp
+                        return x0, eps
+                else:
+                    x, rng, state = carry
+                    pair, idx = inp
+                    step_denoise = denoise
                 t_cur, t_next = pair[0], pair[1]
                 rng, sub = jax.random.split(rng)
                 x_next, state = self.sampler.step(
-                    denoise, x, t_cur, t_next, sub, state, self.schedule, idx)
+                    step_denoise, x, t_cur, t_next, sub, state,
+                    self.schedule, idx)
                 if inpaint:
                     # Masked generation (SD-inpainting "replacement"
                     # semantics): outside the mask the trajectory is
@@ -201,12 +349,20 @@ class DiffusionSampler:
                     t_b = jnp.full((x.shape[0],), t_next)
                     known_t = self.schedule.add_noise(known, noise, t_b)
                     x_next = mask * x_next + (1.0 - mask) * known_t
+                if cached:
+                    return (x_next, rng, state, taps_box[0]), ()
                 return (x_next, rng, state), ()
 
             state0 = self.sampler.init_state(x_init)
-            (x, _, _), _ = jax.lax.scan(
-                scan_step, (x_init, key, state0),
-                (pairs, jnp.arange(num_steps)))
+            if cached:
+                taps0 = self.cache_taps_init(params, x_init, cond, uncond)
+                (x, _, _, _), _ = jax.lax.scan(
+                    scan_step, (x_init, key, state0, taps0),
+                    (pairs, jnp.arange(num_steps), flags))
+            else:
+                (x, _, _), _ = jax.lax.scan(
+                    scan_step, (x_init, key, state0),
+                    (pairs, jnp.arange(num_steps)))
             # terminal denoise: plain model call at the final step value
             # (reference samplers/common.py:384-388)
             x0, _ = denoise(x, jnp.full((x.shape[0],), steps[-1]))
@@ -364,6 +520,82 @@ class DiffusionSampler:
 
             return jax.vmap(row)(x, keys, pairs, n_act, offsets,
                                  cond, uncond, state)
+
+        return jax.jit(program)
+
+    def make_cached_chunk_program(self, round_steps: int):
+        """Continuous-batching round WITH the diffusion cache: the
+        chunk-program contract plus
+
+          flags [round_steps] bool   round-level refresh schedule
+          taps  [R, ...] pytree      per-row cache carry (rides the
+                                     RequestState like x/rng/state)
+
+        and `(x, keys, state, taps)` carries out.
+
+        Structure flips to scan-outside / vmap-inside: the refresh
+        decision must be a SCALAR `lax.cond` — vmapping a cond over
+        per-row predicates lowers to `select`, which executes both
+        branches and erases the speedup. The round flags are therefore
+        shared by every row: the engine ORs each row's own
+        offset-aligned schedule into them, so a row never misses its
+        scheduled refresh (it may get extra free refreshes from its
+        round-mates, which only improves fidelity). Per-row RNG
+        lineage, active-step masking, and the sampler-state carry are
+        unchanged from `make_chunk_program` — a refresh-every-step
+        plan is bit-identical to the uncached chunk path (tested).
+        """
+        def program(params, x, keys, pairs, n_act, offsets, cond, uncond,
+                    state, flags, taps):
+            def make_step(mode):
+                def step_all(x_c, subs, st, tp, pair_i, i):
+                    def row(x_r, sub, s_r, tp_r, pr, off, c, u):
+                        dn = self._denoise_taps_mode_fn(
+                            params, c, u, mode)
+                        taps_box = [tp_r]
+
+                        def step_denoise(x_, t_):
+                            x0, eps, tpn = dn(x_, t_, taps_box[0])
+                            taps_box[0] = tpn
+                            return x0, eps
+
+                        x_n, s_n = self.sampler.step(
+                            step_denoise, x_r, pr[0], pr[1], sub, s_r,
+                            self.schedule, off + i)
+                        return x_n, s_n, taps_box[0]
+
+                    return jax.vmap(row)(x_c, subs, st, tp, pair_i,
+                                         offsets, cond, uncond)
+                return step_all
+
+            record_step = make_step("record")
+            reuse_step = make_step("reuse")
+
+            def scan_step(carry, inp):
+                x_c, rngs, st, tp = carry
+                pair_i, i, refresh = inp
+                # per-row split, same lineage as the uncached row scan:
+                # rng, sub = split(rng) at every step
+                both = jax.vmap(jax.random.split)(rngs)
+                rngs_n, subs = both[:, 0], both[:, 1]
+                x_n, s_n, tp_n = jax.lax.cond(
+                    refresh, record_step, reuse_step,
+                    x_c, subs, st, tp, pair_i, i)
+                active = i < n_act
+
+                def sel(a, b):
+                    return jnp.where(bcast_right(active, a.ndim), a, b)
+
+                x_n = sel(x_n, x_c)
+                s_n = jax.tree_util.tree_map(sel, s_n, st)
+                tp_n = jax.tree_util.tree_map(sel, tp_n, tp)
+                return (x_n, rngs_n, s_n, tp_n), ()
+
+            (x_o, keys_o, state_o, taps_o), _ = jax.lax.scan(
+                scan_step, (x, keys, state, taps),
+                (jnp.swapaxes(pairs, 0, 1), jnp.arange(round_steps),
+                 flags))
+            return x_o, keys_o, state_o, taps_o
 
         return jax.jit(program)
 

@@ -5,7 +5,7 @@ existing single-`lax.scan` `DiffusionSampler`, keyed on
 
     (kind, batch_bucket, resolution, sequence_length, scan_steps,
      sampler, guidance, use_ema, num_samples, channels,
-     has_cond, has_uncond)
+     has_cond, has_uncond, cache_plan)
 
 so repeat traffic never re-traces. `scan_steps` is the program's scan
 trip count — the whole (bucketed) NFE in run-to-completion mode, the
@@ -62,12 +62,12 @@ class RequestState:
     __slots__ = ("req", "future", "submit_t", "admit_t", "group",
                  "x", "rng", "state", "pairs", "terminal_t", "nfe",
                  "done", "cond", "uncond", "compile_ms", "rounds",
-                 "first_dispatch_t")
+                 "first_dispatch_t", "plan", "flags", "taps")
 
     def __init__(self, req: SampleRequest, future: ServingFuture,
                  submit_t: float, admit_t: float, group: tuple,
                  x, rng, state, pairs, terminal_t: float,
-                 cond, uncond):
+                 cond, uncond, plan=None, flags=None, taps=None):
         self.req = req
         self.future = future
         self.submit_t = submit_t
@@ -85,6 +85,12 @@ class RequestState:
         self.compile_ms = 0.0
         self.rounds = 0
         self.first_dispatch_t: Optional[float] = None
+        # training-free diffusion cache (docs/CACHING.md): the
+        # request's plan, its host-side [nfe] refresh schedule, and the
+        # device-resident activation-cache carry
+        self.plan = plan
+        self.flags = flags
+        self.taps = taps
 
     @property
     def remaining(self) -> int:
@@ -104,10 +110,27 @@ class SamplerProgramEngine:
         self._programs: Dict[tuple, Any] = {}
 
     # -- keys -----------------------------------------------------------------
+    def _plan_for(self, req: SampleRequest):
+        """The request's effective CachePlan: None when absent,
+        disabled, or the pipeline's model cannot honor it (counted at
+        `serving/cache_unsupported` — the request still runs, uncached,
+        preserving the bit-exact default)."""
+        from ..ops.diffcache import active_plan, model_supports_cache
+        plan = active_plan(req.cache_plan)
+        if plan is None:
+            return None
+        if not model_supports_cache(self.pipeline.model, plan):
+            self.telemetry.counter("serving/cache_unsupported").inc()
+            return None
+        return plan
+
     def group_key(self, req: SampleRequest) -> tuple:
         """Compatibility key: requests sharing it may ride one round.
         NFE is deliberately absent — rows mask their own trajectory
-        length, so short requests don't queue behind long ones."""
+        length, so short requests don't queue behind long ones. The
+        cache plan IS present (last element): plans change the compiled
+        program (taps carry + depth split), so two plans must never
+        share a round or a program (collision-tested)."""
         use_ema = bool(req.use_ema
                        and self.pipeline.ema_params is not None)
         ic = self.pipeline.input_config
@@ -119,10 +142,12 @@ class SamplerProgramEngine:
         has_uncond = bool((req.prompts is not None
                            or req.conditioning is not None)
                           and conditional)
+        plan = self._plan_for(req)
         return (int(req.resolution), req.sequence_length,
                 int(req.channels), int(req.num_samples),
                 str(req.sampler), float(req.guidance_scale),
-                use_ema, has_cond, has_uncond)
+                use_ema, has_cond, has_uncond,
+                plan.key() if plan is not None else None)
 
     def _program_key(self, kind: str, group: tuple, bucket: int,
                      scan_steps: int) -> tuple:
@@ -146,7 +171,8 @@ class SamplerProgramEngine:
 
     # -- request admission ----------------------------------------------------
     def _sampler_for(self, req: SampleRequest):
-        return self.pipeline.get_sampler(req.sampler, req.guidance_scale)
+        return self.pipeline.get_sampler(req.sampler, req.guidance_scale,
+                                         cache_plan=self._plan_for(req))
 
     def _params_for(self, group: tuple):
         use_ema = group[6]
@@ -195,11 +221,26 @@ class SamplerProgramEngine:
         x = jax.random.normal(noise_key, shape) * ds.schedule.max_noise_std()
         pairs, terminal_t = ds.trajectory_inputs(int(req.diffusion_steps))
         state = ds.sampler.init_state(x)
+        plan = self._plan_for(req)
+        flags = taps = None
+        if plan is not None:
+            # host-side numpy schedule (zero device work) + a zero taps
+            # carry shaped by eval_shape; step 0 of the plan always
+            # refreshes, so the zeros are never consumed
+            flags = plan.flags(int(req.diffusion_steps))
+            taps = ds.cache_taps_init(self._params_for_req(req), x,
+                                      cond, uncond)
         return RequestState(
             req=req, future=future, submit_t=submit_t, admit_t=admit_t,
             group=self.group_key(req), x=x, rng=loop_key, state=state,
             pairs=pairs, terminal_t=float(terminal_t), cond=cond,
-            uncond=uncond)
+            uncond=uncond, plan=plan, flags=flags, taps=taps)
+
+    def _params_for_req(self, req: SampleRequest):
+        use_ema = bool(req.use_ema
+                       and self.pipeline.ema_params is not None)
+        return (self.pipeline.ema_params
+                if use_ema else self.pipeline.params)
 
     # -- batched rounds -------------------------------------------------------
     def _stack_rows(self, rows: List[RequestState], bucket: int):
@@ -219,7 +260,9 @@ class SamplerProgramEngine:
         group = rows[0].group
         cond = stack(lambda r: r.cond) if group[7] else None
         uncond = stack(lambda r: r.uncond) if group[8] else None
-        return x, keys, state, cond, uncond
+        taps = (stack(lambda r: r.taps)
+                if rows[0].plan is not None else None)
+        return x, keys, state, cond, uncond, taps
 
     def advance(self, rows: List[RequestState], bucket: int,
                 round_steps: int) -> Tuple[List[RequestState], float]:
@@ -229,7 +272,8 @@ class SamplerProgramEngine:
         hit)."""
         group = rows[0].group
         ds = self._sampler_for(rows[0].req)
-        x, keys, state, cond, uncond = self._stack_rows(rows, bucket)
+        plan = rows[0].plan             # group-uniform (plan is in the key)
+        x, keys, state, cond, uncond, taps = self._stack_rows(rows, bucket)
 
         pad = bucket - len(rows)
         chunk_pairs, n_act, offsets = [], [], []
@@ -249,13 +293,42 @@ class SamplerProgramEngine:
         n_act_a = jnp.asarray(n_act, jnp.int32)
         offsets_a = jnp.asarray(offsets, jnp.int32)
 
-        program, miss = self._get_program(
-            "chunk", group, bucket, round_steps,
-            lambda: ds.make_chunk_program(round_steps))
         t0 = time.perf_counter()
-        x_n, keys_n, state_n = program(
-            self._params_for(group), x, keys, pairs, n_act_a, offsets_a,
-            cond, uncond, state)
+        if plan is None:
+            program, miss = self._get_program(
+                "chunk", group, bucket, round_steps,
+                lambda: ds.make_chunk_program(round_steps))
+            x_n, keys_n, state_n = program(
+                self._params_for(group), x, keys, pairs, n_act_a,
+                offsets_a, cond, uncond, state)
+            taps_n = None
+        else:
+            # round-level refresh flags: OR of each row's own
+            # offset-aligned schedule (host-side numpy, zero syncs) —
+            # no row ever misses ITS scheduled refresh; round-mates may
+            # grant extra free refreshes (fidelity can only improve)
+            want = [False] * round_steps
+            for r in rows:
+                w = r.flags[r.done:r.done + round_steps]
+                for j in range(len(w)):
+                    want[j] = want[j] or bool(w[j])
+            flags_a = jnp.asarray(want)
+            program, miss = self._get_program(
+                "chunk_cached", group, bucket, round_steps,
+                lambda: ds.make_cached_chunk_program(round_steps))
+            x_n, keys_n, state_n, taps_n = program(
+                self._params_for(group), x, keys, pairs, n_act_a,
+                offsets_a, cond, uncond, state, flags_a, taps)
+            self.telemetry.counter("serving/cache_rows").inc(len(rows))
+            refresh = reused = 0
+            for i, r in enumerate(rows):
+                for j in range(n_act[i]):
+                    refresh += int(want[j])
+                    reused += int(not want[j])
+            self.telemetry.counter(
+                "serving/cache_refresh_steps").inc(refresh)
+            self.telemetry.counter(
+                "serving/cache_reused_steps").inc(reused)
         compile_s = (time.perf_counter() - t0) if miss else 0.0
 
         finished: List[RequestState] = []
@@ -263,6 +336,8 @@ class SamplerProgramEngine:
             r.x = x_n[i]
             r.rng = keys_n[i]
             r.state = jax.tree_util.tree_map(lambda a: a[i], state_n)
+            if taps_n is not None:
+                r.taps = jax.tree_util.tree_map(lambda a: a[i], taps_n)
             r.done += int(n_act[i])
             r.rounds += 1
             r.compile_ms += compile_s * 1e3
@@ -277,7 +352,7 @@ class SamplerProgramEngine:
         row order, compile seconds)."""
         group = rows[0].group
         ds = self._sampler_for(rows[0].req)
-        x, _, _, cond, uncond = self._stack_rows(rows, bucket)
+        x, _, _, cond, uncond, _ = self._stack_rows(rows, bucket)
         pad = bucket - len(rows)
         t_term = jnp.asarray(
             [r.terminal_t for r in rows + [rows[0]] * pad], jnp.float32)

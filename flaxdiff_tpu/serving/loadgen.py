@@ -91,6 +91,12 @@ def replay(scheduler, workload: List[Tuple[float, SampleRequest]],
         if results else None,
         "device_ms_mean": float(np.mean([r.device_ms for r in results]))
         if results else None,
+        # NFE-normalized device cost: the serving-side analogue of the
+        # bench diffcache stage's per-step number — a cached replay of
+        # the same workload should drop this, same stage that guards it
+        "device_ms_per_step_mean": float(np.mean(
+            [r.device_ms / max(1, r.request.diffusion_steps)
+             for r in results])) if results else None,
         "rounds_mean": float(np.mean([r.rounds for r in results]))
         if results else None,
     }

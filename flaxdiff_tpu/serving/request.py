@@ -40,6 +40,13 @@ class SampleRequest:
     the encoder with a pre-encoded array. `deadline_s` is a relative
     latency budget from submit time; a request that is still queued
     when it expires is shed before any compute is spent on it.
+
+    `cache_plan` is the per-request quality/latency knob: an
+    `ops.diffcache.CachePlan` activates the training-free activation
+    cache for this request's trajectory (docs/CACHING.md). None (the
+    default) keeps sampling bit-identical to the uncached path. The
+    plan is part of the engine's group/program cache key, so requests
+    with different plans never share a compiled program.
     """
     num_samples: int = 1
     resolution: int = 64
@@ -53,6 +60,7 @@ class SampleRequest:
     channels: int = 3
     use_ema: bool = True
     deadline_s: Optional[float] = None
+    cache_plan: Optional[Any] = None    # ops.diffcache.CachePlan
 
     def __post_init__(self):
         if self.diffusion_steps < 1:

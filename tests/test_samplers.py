@@ -194,6 +194,54 @@ def test_timestep_spacing_strategies():
         assert bool(jnp.all(jnp.diff(steps) < 1e-6)), method
 
 
+@pytest.mark.parametrize("method",
+                         ["linear", "quadratic", "karras", "exponential"])
+@pytest.mark.parametrize("num_steps", [1, 2, 3])
+@pytest.mark.parametrize("sched_name", ["none", "cosine", "karras_ve"])
+def test_timestep_spacing_few_steps(method, num_steps, sched_name):
+    """Few-step trajectories (the regime diffusion caching pushes
+    toward) must produce valid, strictly monotone (t_cur, t_next)
+    pairs with EXACT endpoints for every spacing method. Regression:
+    the nonlinear spacings round-tripped hi through f32 powers/logs
+    and came back ABOVE the schedule domain (999.0002 for
+    timesteps=1000) — at num_steps 1-3 that drift is a whole step."""
+    schedule = {"none": None,
+                "cosine": CosineNoiseSchedule(timesteps=1000),
+                "karras_ve": KarrasVENoiseSchedule(timesteps=1000)
+                }[sched_name]
+    steps = np.asarray(get_timestep_spacing(
+        method, num_steps, 1000, schedule=schedule))
+    assert steps.shape == (num_steps + 1,)
+    assert np.isfinite(steps).all()
+    # exact endpoints: first value IS the domain max, terminal IS end
+    assert steps[0] == 999.0
+    assert steps[-1] == 0.0
+    # strictly decreasing -> every scan pair has t_cur > t_next
+    assert np.all(np.diff(steps) < 0), steps
+    pairs = np.stack([steps[:-1], steps[1:]], axis=1)
+    assert pairs.shape == (num_steps, 2)
+    assert np.all(pairs[:, 0] > pairs[:, 1])
+
+
+@pytest.mark.parametrize("num_steps", [1, 2, 3])
+def test_few_step_sampling_end_to_end(num_steps):
+    """The few-step spacings drive the real scan program: with the
+    perfect delta-model even 1-3 steps must produce finite samples
+    biased toward MU (DDIM with an exact model needs few steps)."""
+    schedule = CosineNoiseSchedule(timesteps=1000)
+    for spacing in ("linear", "karras"):
+        engine = DiffusionSampler(
+            model_fn=make_delta_model(schedule), schedule=schedule,
+            transform=EpsilonPredictionTransform(), sampler=DDIMSampler(),
+            timestep_spacing=spacing)
+        out = np.asarray(engine.generate_samples(
+            params=None, num_samples=4, resolution=8,
+            diffusion_steps=num_steps, rngstate=RngSeq.create(0),
+            channels=1))
+        assert np.isfinite(out).all()
+        np.testing.assert_allclose(out, MU, atol=0.2)
+
+
 def test_cfg_batching():
     """Guidance path doubles the batch and blends cond/uncond."""
     schedule = CosineNoiseSchedule(timesteps=100)
