@@ -107,6 +107,25 @@ def main(argv=None):
                             jnp.float32))
 
     fixed = batch(0)   # overfit one batch so the loss must descend
+
+    # Pipeline-correctness check: at the SAME params, the pipelined
+    # loss AND gradients must match the plain ones essentially bitwise
+    # (measured 0.0 on the 8-virtual-device CPU mesh) — this is the
+    # "same params, same numbers" claim, checked where it is exact.
+    vg = jax.jit(lambda pl, p, b: jax.value_and_grad(loss_fn)(
+        p, *b, pl), static_argnums=0)
+    l_pipe, g_pipe = vg(True, params, fixed)
+    l_plain, g_plain = vg(False, params, fixed)
+    grad_drift = max(
+        float(jnp.max(jnp.abs(a - b))) for a, b in zip(
+            jax.tree_util.tree_leaves(g_pipe),
+            jax.tree_util.tree_leaves(g_plain)))
+    loss_drift0 = abs(float(l_pipe) - float(l_plain))
+    print(f"same-params loss drift {loss_drift0:.2e}, "
+          f"max grad drift {grad_drift:.2e}")
+    assert loss_drift0 < 1e-6, loss_drift0
+    assert grad_drift < 1e-5, grad_drift
+
     histories = {}
     for name, pipelined in (("pipelined", True), ("plain", False)):
         p, s = params, opt.init(params)
@@ -122,10 +141,19 @@ def main(argv=None):
                                            histories["plain"]))
     print(f"max |pipelined - plain| loss drift over "
           f"{args.steps} steps: {drift:.2e}")
-    assert drift < 1e-3, drift
+    # Trajectory drift is NOT a bitwise claim: the two train steps are
+    # different XLA programs, so the fused adam epilogue rounds the
+    # (identical — asserted above) gradients differently at the ulp
+    # level, and adam's zero-init moments + sqrt(v)+eps normalization
+    # amplify ulp-scale parameter differences to O(learning_rate) per
+    # step — measured ~2.5 lr-quanta/step here (9.6e-3 over 4 steps at
+    # lr=2e-3). The bound below is the amplification allowance; the
+    # exactness claim lives in the same-params assert above.
+    assert drift < args.steps * 5 * 2e-3, drift
     if args.steps >= 10:   # zero-init final_proj: a few steps barely move
         assert histories["pipelined"][-1] < histories["pipelined"][0]
-    return {"final_loss": histories["pipelined"][-1], "drift": drift}
+    return {"final_loss": histories["pipelined"][-1], "drift": drift,
+            "grad_drift": grad_drift}
 
 
 if __name__ == "__main__":

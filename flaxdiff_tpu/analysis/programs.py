@@ -33,23 +33,42 @@ def _tiny_model():
         # (ops/diffcache.py) so the cached sampler programs can be
         # traced around the same tiny backbone: the first conv is the
         # always-run shallow part, the middle conv the cached deep
-        # delta
+        # delta. The spatial modes (ops/spatialcache.py) treat grid
+        # positions as tokens and scatter through a top-k mask — a
+        # conv backbone can't gather a token subset out of the grid
+        # (windows need neighbors), but the lint invariants live in
+        # the SAMPLER code (switch structure, RNG lineage, carries),
+        # which this traces exactly; param tree stays mode-invariant.
 
         @nn.compact
         def __call__(self, x, t, cond=None, cache_mode=None,
-                     cache_taps=None):
+                     cache_taps=None, cache_ref=None):
             # explicit names: the reuse path skips the deep conv, so
             # compact auto-numbering would shift the tail conv's name
             h = nn.Conv(8, (3, 3), name="shallow")(x)
             if cache_mode == "reuse":
                 h = h + cache_taps
                 taps = cache_taps
+            elif cache_mode == "spatial":
+                scores = jnp.mean(
+                    jnp.square(h - cache_ref), axis=(0, 3)).reshape(-1)
+                k = max(1, scores.shape[0] // 4)
+                _, idx = jax.lax.top_k(scores, k)
+                mask = jnp.zeros_like(scores).at[idx].set(1.0) \
+                    .reshape(h.shape[1], h.shape[2])[None, :, :, None]
+                deep = nn.Conv(8, (3, 3), name="deep")(jnp.tanh(h))
+                taps = mask * deep + (1.0 - mask) * cache_taps
+                ref = mask * h + (1.0 - mask) * cache_ref
+                h = h + taps
             else:
                 taps = nn.Conv(8, (3, 3), name="deep")(jnp.tanh(h))
+                ref = h
                 h = h + taps
             out = nn.Conv(x.shape[-1], (3, 3), name="tail")(jnp.tanh(h))
             if cache_mode == "record":
                 return out, taps
+            if cache_mode in ("record_ref", "spatial"):
+                return out, taps, ref
             return out
 
     model = Tiny()
@@ -69,7 +88,20 @@ def _tiny_model():
         return model.apply({"params": params}, x, t, None,
                            cache_mode="reuse", cache_taps=taps)
 
-    return apply_fn, init_fn, (record_fn, reuse_fn)
+    def record_ref_fn(params, x, t, cond):
+        return model.apply({"params": params}, x, t, None,
+                           cache_mode="record_ref")
+
+    def spatial_fn(params, x, t, cond, taps, ref):
+        return model.apply({"params": params}, x, t, None,
+                           cache_mode="spatial", cache_taps=taps,
+                           cache_ref=ref)
+
+    from ..ops.spatialcache import ComposedCacheFns
+    fns = ComposedCacheFns(record=record_fn, reuse=reuse_fn,
+                           record_ref=record_ref_fn,
+                           spatial=spatial_fn)
+    return apply_fn, init_fn, fns
 
 
 @functools.lru_cache(maxsize=None)
@@ -110,8 +142,10 @@ def train_step_jaxpr(monitored: bool = False, bf16: bool = False):
 
 
 @functools.lru_cache(maxsize=None)
-def _sampler_pieces(sampler_name: str, cached: bool = False):
+def _sampler_pieces(sampler_name: str, cached: bool = False,
+                    spatial: bool = False):
     from ..ops.diffcache import CachePlan
+    from ..ops.spatialcache import ComposedPlan, SpatialPlan
     from ..predictors import EpsilonPredictionTransform
     from ..samplers import SAMPLER_REGISTRY, DiffusionSampler
     from ..schedulers import CosineNoiseSchedule
@@ -123,12 +157,18 @@ def _sampler_pieces(sampler_name: str, cached: bool = False):
     def model_fn(p, x, t, cond):
         return apply_fn(p, x, t, cond)
 
+    plan = None
+    if spatial:
+        plan = ComposedPlan(cache=CachePlan(refresh_every=2),
+                            spatial=SpatialPlan(keep_fraction=0.25))
+    elif cached:
+        plan = CachePlan(refresh_every=2)
     ds = DiffusionSampler(
         model_fn, CosineNoiseSchedule(timesteps=100),
         EpsilonPredictionTransform(),
         SAMPLER_REGISTRY[sampler_name](),
-        cache_plan=CachePlan(refresh_every=2) if cached else None,
-        cache_fns=cache_fns if cached else None)
+        cache_plan=plan,
+        cache_fns=cache_fns if plan is not None else None)
     return ds, params
 
 
@@ -163,11 +203,15 @@ def terminal_program_jaxpr(sampler_name: str, rows: int = 2):
 
 
 def solo_program_jaxpr(sampler_name: str = "ddim", steps: int = 4,
-                       cached: bool = False):
+                       cached: bool = False, spatial: bool = False):
     """The solo single-scan trajectory program generate_samples runs;
     with `cached`, the diffusion-cache variant (taps carry + per-step
-    `lax.cond` refresh gating, ops/diffcache.py)."""
-    ds, params = _sampler_pieces(sampler_name, cached=cached)
+    `lax.cond` refresh gating, ops/diffcache.py); with `spatial`, the
+    composed timestep x spatial variant (taps + score-reference
+    carries, per-step `lax.switch` over the three-way code row,
+    ops/spatialcache.py)."""
+    ds, params = _sampler_pieces(sampler_name, cached=cached,
+                                 spatial=spatial)
     shape = (2, 8, 8, 1)
     prog = ds._get_program(steps, shape, None, 0.0)
     x = jnp.zeros(shape, jnp.float32)
@@ -198,6 +242,31 @@ def cached_chunk_program_jaxpr(sampler_name: str = "ddim",
                                 None, None, state, flags, taps)
 
 
+def spatial_chunk_program_jaxpr(sampler_name: str = "ddim",
+                                rows: int = 2, round_steps: int = 2):
+    """The serving layer's composed spatially-cached round
+    (`make_spatial_chunk_program`) with the exact input layout
+    `SamplerProgramEngine.advance` feeds it on the composed path:
+    round-level step codes + per-row taps AND score-reference
+    carries."""
+    ds, params = _sampler_pieces(sampler_name, spatial=True)
+    prog = ds.make_spatial_chunk_program(round_steps)
+    x = jnp.zeros((rows, 1, 8, 8, 1), jnp.float32)
+    keys = jnp.stack([jax.random.PRNGKey(i) for i in range(rows)])
+    pairs = jnp.zeros((rows, round_steps, 2), jnp.float32)
+    n_act = jnp.zeros((rows,), jnp.int32)
+    offsets = jnp.zeros((rows,), jnp.int32)
+    row_states = [ds.sampler.init_state(
+        jnp.zeros((1, 8, 8, 1), jnp.float32)) for _ in range(rows)]
+    state = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                   *row_states)
+    codes = jnp.zeros((round_steps,), jnp.int32)
+    taps = jnp.zeros((rows, 1, 8, 8, 8), jnp.float32)
+    refs = jnp.zeros((rows, 1, 8, 8, 8), jnp.float32)
+    return jax.make_jaxpr(prog)(params, x, keys, pairs, n_act, offsets,
+                                None, None, state, codes, taps, refs)
+
+
 # the inventory the CLI and the tier-1 clean-pass tests iterate
 PROGRAM_BUILDERS = {
     "train_step": lambda: train_step_jaxpr(),
@@ -213,6 +282,12 @@ PROGRAM_BUILDERS = {
     "solo_ddim": lambda: solo_program_jaxpr("ddim"),
     "solo_ddim_cached":
         lambda: solo_program_jaxpr("ddim", cached=True),
+    "solo_ddim_spatial":
+        lambda: solo_program_jaxpr("ddim", spatial=True),
+    "chunk_ddim_spatial":
+        lambda: spatial_chunk_program_jaxpr("ddim"),
+    "chunk_euler_ancestral_spatial":
+        lambda: spatial_chunk_program_jaxpr("euler_ancestral"),
 }
 
 

@@ -197,11 +197,19 @@ class DiffusionInferencePipeline:
     def get_sampler(self, sampler: str | Sampler | Type[Sampler] = "ddim",
                     guidance_scale: float = 0.0,
                     cache_plan=None) -> DiffusionSampler:
-        """`cache_plan` (ops.diffcache.CachePlan) activates the
-        training-free activation cache (docs/CACHING.md). The plan is
-        folded into the sampler cache key — two plans never share a
-        compiled DiffusionSampler, mirroring the DDIM-eta key rule."""
-        from ..ops.diffcache import active_plan, resolve_cache_fns
+        """`cache_plan` (ops.diffcache.CachePlan, or an
+        ops.spatialcache ComposedPlan/SpatialPlan for the token-level
+        axis) activates the training-free activation cache
+        (docs/CACHING.md). The plan is NORMALIZED first — degenerate
+        axes route to the simpler program byte-for-byte (spatial
+        keep 1.0 -> the timestep-cached program, refresh_every=1 ->
+        the uncached one) — then folded into the sampler cache key, so
+        two effective plans never share a compiled DiffusionSampler,
+        mirroring the DDIM-eta key rule."""
+        from ..ops.diffcache import resolve_cache_fns
+        from ..ops.spatialcache import (ComposedPlan,
+                                        resolve_composed_fns,
+                                        resolve_plan)
         if isinstance(sampler, str):
             if sampler not in SAMPLER_REGISTRY:
                 raise ValueError(f"unknown sampler {sampler!r}")
@@ -210,12 +218,16 @@ class DiffusionInferencePipeline:
             sampler_obj = sampler()
         else:
             sampler_obj = sampler
-        plan = active_plan(cache_plan)
+        plan = resolve_plan(cache_plan)
         key = _sampler_cache_key(sampler_obj, guidance_scale) \
             + (plan.key() if plan is not None else None,)
         if key not in self._sampler_cache:
-            cache_fns = (resolve_cache_fns(self.model, plan)
-                         if plan is not None else None)
+            if plan is None:
+                cache_fns = None
+            elif isinstance(plan, ComposedPlan):
+                cache_fns = resolve_composed_fns(self.model, plan)
+            else:
+                cache_fns = resolve_cache_fns(self.model, plan)
             self._sampler_cache[key] = DiffusionSampler(
                 model_fn=lambda p, x, t, c: self.model.apply(p, x, t, c),
                 schedule=self.schedule, transform=self.transform,
@@ -268,7 +280,18 @@ class DiffusionInferencePipeline:
                               cache_plan=cache_plan)
         from ..telemetry import global_telemetry
         tel = global_telemetry()
-        if ds.cache_active:
+        if ds.spatial_active:
+            # plan accounting is pure host arithmetic on the static
+            # schedule — no device syncs
+            counts = ds.cache_plan.counts(diffusion_steps)
+            tel.counter("diffcache/requests").inc()
+            tel.counter("diffcache/spatial_requests").inc()
+            tel.counter("diffcache/refresh_steps").inc(
+                counts["refresh"])
+            tel.counter("diffcache/spatial_steps").inc(
+                counts["spatial"])
+            tel.counter("diffcache/reused_steps").inc(counts["reused"])
+        elif ds.cache_active:
             # plan accounting is pure host arithmetic on the static
             # schedule — no device syncs
             flags = ds.cache_plan.flags(diffusion_steps)

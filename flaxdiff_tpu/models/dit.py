@@ -205,32 +205,61 @@ class SimpleDiT(nn.Module):
                  textcontext: Optional[jax.Array] = None,
                  cache_mode: Optional[str] = None,
                  cache_split: int = 0,
-                 cache_taps: Optional[jax.Array] = None) -> jax.Array:
+                 cache_taps: Optional[jax.Array] = None,
+                 cache_ref: Optional[jax.Array] = None,
+                 cache_keep: float = 1.0,
+                 cache_metric: str = "l2") -> jax.Array:
         B, H, W, C = x.shape
         tokens, cond, freqs, inv_idx = self.head(x, temb, textcontext)
         if cache_mode is None:
             for block in self.blocks:
                 tokens = block(tokens, cond, freqs)
             return self.tail(tokens, inv_idx, H, W)
-        # Training-free diffusion cache forward (ops/diffcache.py,
-        # docs/CACHING.md). "record" runs the EXACT same block sequence
-        # as the plain path (bit-identical output, tested) and
-        # additionally returns the deep trunk's residual delta;
-        # "reuse" re-centers a previously recorded delta on the fresh
-        # shallow activations instead of running the deep blocks.
+        # Training-free diffusion cache forward (ops/diffcache.py +
+        # ops/spatialcache.py, docs/CACHING.md). "record" runs the
+        # EXACT same block sequence as the plain path (bit-identical
+        # output, tested) and additionally returns the deep trunk's
+        # residual delta; "record_ref" also returns the shallow
+        # activations as the spatial cache's score reference; "reuse"
+        # re-centers a previously recorded delta on the fresh shallow
+        # activations instead of running the deep blocks; "spatial"
+        # sends only a static top-k of highest-change tokens through
+        # the deep blocks and scatters their fresh delta/reference
+        # entries back into the carries.
         split = int(cache_split)
         if not 0 < split < self.num_layers:
             raise ValueError(f"cache_split {split} out of range for "
                              f"{self.num_layers} blocks")
         for block in self.blocks[:split]:
             tokens = block(tokens, cond, freqs)
-        if cache_mode == "record":
+        if cache_mode in ("record", "record_ref"):
             deep = tokens
             for block in self.blocks[split:]:
                 deep = block(deep, cond, freqs)
-            return self.tail(deep, inv_idx, H, W), deep - tokens
+            out = self.tail(deep, inv_idx, H, W)
+            if cache_mode == "record_ref":
+                return out, deep - tokens, tokens
+            return out, deep - tokens
         if cache_mode == "reuse":
             if cache_taps is None:
                 raise ValueError("cache_mode='reuse' requires cache_taps")
             return self.tail(tokens + cache_taps, inv_idx, H, W)
+        if cache_mode == "spatial":
+            if cache_taps is None or cache_ref is None:
+                raise ValueError(
+                    "cache_mode='spatial' requires cache_taps and "
+                    "cache_ref")
+            from ..ops.spatialcache import (gather_freqs, gather_tokens,
+                                            scatter_tokens,
+                                            select_tokens)
+            idx = select_tokens(tokens, cache_ref, cache_keep,
+                                cache_metric)
+            sel = gather_tokens(tokens, idx)
+            deep = sel
+            freqs_sel = gather_freqs(freqs, idx)
+            for block in self.blocks[split:]:
+                deep = block(deep, cond, freqs_sel)
+            taps = scatter_tokens(cache_taps, idx, deep - sel)
+            ref = scatter_tokens(cache_ref, idx, sel)
+            return self.tail(tokens + taps, inv_idx, H, W), taps, ref
         raise ValueError(f"unknown cache_mode {cache_mode!r}")

@@ -153,7 +153,10 @@ class SimpleMMDiT(nn.Module):
                  textcontext: jax.Array,
                  cache_mode: Optional[str] = None,
                  cache_split: int = 0,
-                 cache_taps: Optional[jax.Array] = None) -> jax.Array:
+                 cache_taps: Optional[jax.Array] = None,
+                 cache_ref: Optional[jax.Array] = None,
+                 cache_keep: float = 1.0,
+                 cache_metric: str = "l2") -> jax.Array:
         if textcontext is None:
             raise ValueError("SimpleMMDiT requires textcontext")
         B, H, W, C = x.shape
@@ -183,7 +186,7 @@ class SimpleMMDiT(nn.Module):
         freqs = rope_frequencies(self.emb_features // self.num_heads,
                                  tokens.shape[1])
 
-        def run_block(i, h):
+        def run_block(i, h, fr=None):
             return MMDiTBlock(
                 features=self.emb_features, num_heads=self.num_heads,
                 mlp_ratio=self.mlp_ratio, backend=self.backend,
@@ -191,34 +194,58 @@ class SimpleMMDiT(nn.Module):
                 force_fp32_for_softmax=self.force_fp32_for_softmax,
                 norm_epsilon=self.norm_epsilon, activation=self.activation,
                 fused_epilogues=self.fused_epilogues,
-                name=f"block_{i}")(h, t_emb, text_emb, freqs)
+                name=f"block_{i}")(h, t_emb, text_emb,
+                                   freqs if fr is None else fr)
 
-        taps = None
+        taps = ref = None
         if cache_mode is None:
             for i in range(self.num_layers):
                 tokens = run_block(i, tokens)
         else:
-            # diffusion-cache forward (ops/diffcache.py): "record" runs
-            # the exact plain block sequence + returns the deep delta;
-            # "reuse" re-centers the cached delta on fresh shallow
-            # activations instead of running the deep blocks.
+            # diffusion-cache forward (ops/diffcache.py +
+            # ops/spatialcache.py): "record"/"record_ref" run the exact
+            # plain block sequence + return the deep delta (and the
+            # shallow score reference); "reuse" re-centers the cached
+            # delta on fresh shallow activations instead of running
+            # the deep blocks; "spatial" runs the deep blocks on a
+            # static top-k of highest-change tokens only.
             split = int(cache_split)
             if not 0 < split < self.num_layers:
                 raise ValueError(f"cache_split {split} out of range "
                                  f"for {self.num_layers} blocks")
             for i in range(split):
                 tokens = run_block(i, tokens)
-            if cache_mode == "record":
+            if cache_mode in ("record", "record_ref"):
                 deep = tokens
                 for i in range(split, self.num_layers):
                     deep = run_block(i, deep)
                 taps = deep - tokens
+                ref = tokens
                 tokens = deep
             elif cache_mode == "reuse":
                 if cache_taps is None:
                     raise ValueError(
                         "cache_mode='reuse' requires cache_taps")
                 tokens = tokens + cache_taps
+            elif cache_mode == "spatial":
+                if cache_taps is None or cache_ref is None:
+                    raise ValueError(
+                        "cache_mode='spatial' requires cache_taps and "
+                        "cache_ref")
+                from ..ops.spatialcache import (gather_freqs,
+                                                gather_tokens,
+                                                scatter_tokens,
+                                                select_tokens)
+                idx = select_tokens(tokens, cache_ref, cache_keep,
+                                    cache_metric)
+                sel = gather_tokens(tokens, idx)
+                freqs_sel = gather_freqs(freqs, idx)
+                deep = sel
+                for i in range(split, self.num_layers):
+                    deep = run_block(i, deep, freqs_sel)
+                taps = scatter_tokens(cache_taps, idx, deep - sel)
+                ref = scatter_tokens(cache_ref, idx, sel)
+                tokens = tokens + taps
             else:
                 raise ValueError(f"unknown cache_mode {cache_mode!r}")
 
@@ -237,6 +264,8 @@ class SimpleMMDiT(nn.Module):
             out = unpatchify(tokens, p, H, W, self.output_channels)
         if cache_mode == "record":
             return out, taps
+        if cache_mode in ("record_ref", "spatial"):
+            return out, taps, ref
         return out
 
 

@@ -177,12 +177,16 @@ class SimpleUDiT(nn.Module):
                  textcontext: Optional[jax.Array] = None,
                  cache_mode: Optional[str] = None,
                  cache_split: int = 0,
-                 cache_taps: Optional[jax.Array] = None) -> jax.Array:
+                 cache_taps: Optional[jax.Array] = None,
+                 cache_ref: Optional[jax.Array] = None,
+                 cache_keep: float = 1.0,
+                 cache_metric: str = "l2") -> jax.Array:
         if self.num_layers % 2:
             raise ValueError("num_layers must be even for the U structure")
         if self.use_hilbert and self.use_zigzag:
             raise ValueError("use_hilbert and use_zigzag are mutually exclusive")
-        if cache_mode not in (None, "record", "reuse"):
+        if cache_mode not in (None, "record", "record_ref", "reuse",
+                              "spatial"):
             raise ValueError(f"unknown cache_mode {cache_mode!r}")
         B, H, W, C = x.shape
         p = self.patch_size
@@ -209,11 +213,11 @@ class SimpleUDiT(nn.Module):
             norm_epsilon=self.norm_epsilon,
             fused_epilogues=self.fused_epilogues, name=name)
 
-        def up(i, h):
-            h = jnp.concatenate([h, skips.pop()], axis=-1)
+        def up(i, h, skip, fr):
+            h = jnp.concatenate([h, skip], axis=-1)
             h = nn.Dense(self.emb_features, dtype=self.dtype,
                          precision=self.precision, name=f"up_fuse_{i}")(h)
-            return block(f"up_{i}")(h, cond, freqs)
+            return block(f"up_{i}")(h, cond, fr)
 
         half = self.num_layers // 2
         s = half if cache_mode is None else int(cache_split)
@@ -221,7 +225,7 @@ class SimpleUDiT(nn.Module):
             raise ValueError(f"cache_split {s} out of range for "
                              f"{self.num_layers} U layers")
         skips = []
-        taps = None
+        taps = ref = None
         h = tokens
         for i in range(s):                       # outer downs (always)
             h = block(f"down_{i}")(h, cond, freqs)
@@ -230,19 +234,48 @@ class SimpleUDiT(nn.Module):
             if cache_taps is None:
                 raise ValueError("cache_mode='reuse' requires cache_taps")
             h = h + cache_taps                   # re-centered core delta
+        elif cache_mode == "spatial":
+            # spatial token cache (ops/spatialcache.py): the inner
+            # core — inner downs + mid + inner ups, including its own
+            # skip concats — runs on a static top-k token subset; the
+            # outer skips stay exact because the full-token outer
+            # blocks re-ran above.
+            if cache_taps is None or cache_ref is None:
+                raise ValueError(
+                    "cache_mode='spatial' requires cache_taps and "
+                    "cache_ref")
+            from ..ops.spatialcache import (gather_freqs, gather_tokens,
+                                            scatter_tokens,
+                                            select_tokens)
+            idx = select_tokens(h, cache_ref, cache_keep, cache_metric)
+            sel = gather_tokens(h, idx)
+            freqs_sel = gather_freqs(freqs, idx)
+            core_skips = []
+            g = sel
+            for i in range(s, half):             # inner downs (subset)
+                g = block(f"down_{i}")(g, cond, freqs_sel)
+                core_skips.append(g)
+            g = block("mid")(g, cond, freqs_sel)
+            for i in range(half - s):            # inner ups (subset)
+                g = up(i, g, core_skips.pop(), freqs_sel)
+            taps = scatter_tokens(cache_taps, idx, g - sel)
+            ref = scatter_tokens(cache_ref, idx, sel)
+            h = h + taps
         else:
-            # plain (s == half: the loops below cover the whole U) and
-            # "record" both run the EXACT original block sequence
+            # plain (s == half: the loops below cover the whole U),
+            # "record" and "record_ref" all run the EXACT original
+            # block sequence
             core_in = h
             for i in range(s, half):             # inner downs
                 h = block(f"down_{i}")(h, cond, freqs)
                 skips.append(h)
             h = block("mid")(h, cond, freqs)
             for i in range(half - s):            # inner ups
-                h = up(i, h)
+                h = up(i, h, skips.pop(), freqs)
             taps = h - core_in
+            ref = core_in
         for i in range(half - s, half):          # outer ups (always)
-            h = up(i, h)
+            h = up(i, h, skips.pop(), freqs)
 
         h = nn.LayerNorm(epsilon=self.norm_epsilon, dtype=jnp.float32,
                          name="final_norm")(h)
@@ -254,4 +287,6 @@ class SimpleUDiT(nn.Module):
             out = unpatchify(h, p, H, W, self.output_channels)
         if cache_mode == "record":
             return out, taps
+        if cache_mode in ("record_ref", "spatial"):
+            return out, taps, ref
         return out

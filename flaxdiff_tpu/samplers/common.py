@@ -157,6 +157,16 @@ class DiffusionSampler:
                 and getattr(self.cache_plan, "enabled", False)
                 and self.cache_fns is not None)
 
+    @property
+    def spatial_active(self) -> bool:
+        """True when the plan composes the spatial token axis on top of
+        the timestep cache (ops/spatialcache.py): the plan carries a
+        `spatial` sub-plan and the cache_fns expose the
+        record_ref/spatial forwards."""
+        return (self.cache_active
+                and getattr(self.cache_plan, "spatial", None) is not None
+                and hasattr(self.cache_fns, "spatial"))
+
     # -- model evaluation with CFG ------------------------------------------
     def _denoise_fn(self, params, cond, uncond):
         schedule, transform = self.schedule, self.transform
@@ -197,7 +207,9 @@ class DiffusionSampler:
         math mirrors `_denoise_fn` exactly so a record-every-step plan
         is bit-identical to the uncached path (tested)."""
         schedule, transform = self.schedule, self.transform
-        record_fn, reuse_fn = self.cache_fns
+        # first two entries by position: works for both the plain
+        # (record, reuse) pair and a ComposedCacheFns
+        record_fn, reuse_fn = self.cache_fns[0], self.cache_fns[1]
         use_cfg = self.guidance_scale > 0.0 and uncond is not None
 
         def denoise(x, t, taps):
@@ -247,6 +259,71 @@ class DiffusionSampler:
 
         return denoise
 
+    # -- composed (timestep x spatial) cached evaluation --------------------
+    def _denoise_composed_mode_fn(self, params, cond, uncond, mode: str):
+        """`denoise(x, t, taps, ref) -> (x0, eps, taps, ref)` for ONE
+        composed-cache mode — "record" (full evaluation, fresh taps +
+        score reference), "spatial" (static top-k token refresh,
+        ops/spatialcache.py) or "reuse" (pure timestep reuse; taps and
+        ref pass through). All three share one carry structure so they
+        can be `lax.switch` branches."""
+        schedule, transform = self.schedule, self.transform
+        fns = self.cache_fns
+        use_cfg = self.guidance_scale > 0.0 and uncond is not None
+
+        def denoise(x, t, taps, ref):
+            t_b = jnp.broadcast_to(t, (x.shape[0],)).astype(jnp.float32)
+            c_in = bcast_right(transform.input_scale(schedule, t_b), x.ndim)
+            x_in, t_in = schedule.transform_inputs(x * c_in, t_b)
+            if use_cfg:
+                x_net = jnp.concatenate([x_in, x_in], axis=0)
+                t_net = jnp.concatenate([t_in, t_in], axis=0)
+                c_net = jax.tree_util.tree_map(
+                    lambda c, u: jnp.concatenate([c, u], axis=0),
+                    cond, uncond)
+            else:
+                x_net, t_net, c_net = x_in, t_in, cond
+            if mode == "record":
+                raw, taps, ref = fns.record_ref(params, x_net, t_net,
+                                                c_net)
+            elif mode == "spatial":
+                raw, taps, ref = fns.spatial(params, x_net, t_net,
+                                             c_net, taps, ref)
+            else:
+                raw = fns.reuse(params, x_net, t_net, c_net, taps)
+            if use_cfg:
+                raw_c, raw_u = jnp.split(raw, 2, axis=0)
+                raw = raw_u + self.guidance_scale * (raw_c - raw_u)
+            pred = transform.transform_output(x, t_b,
+                                              raw.astype(jnp.float32),
+                                              schedule)
+            x0, eps = transform.to_x0_eps(x, t_b, pred, schedule)
+            if self.clip_denoised:
+                x0 = clip_images(x0)
+                _, sigma = schedule.rates(t_b)
+                signal, _ = schedule.rates(t_b)
+                eps = (x - bcast_right(signal, x.ndim) * x0) / jnp.maximum(
+                    bcast_right(sigma, x.ndim), 1e-12)
+            return x0, eps, taps, ref
+
+        return denoise
+
+    def _denoise_composed_fn(self, params, cond, uncond):
+        """`denoise(x, t, taps, ref, code) -> (x0, eps, taps, ref)`: a
+        scalar `lax.switch` over the composed-plan step codes
+        (ops/spatialcache.py CODE_REUSE/CODE_SPATIAL/CODE_REFRESH). Same
+        rule as the timestep cache's cond: the predicate is always a
+        per-STEP scalar — a vmapped switch degenerates to select and
+        executes every branch."""
+        branches = tuple(
+            self._denoise_composed_mode_fn(params, cond, uncond, m)
+            for m in ("reuse", "spatial", "record"))
+
+        def denoise(x, t, taps, ref, code):
+            return jax.lax.switch(code, branches, x, t, taps, ref)
+
+        return denoise
+
     def cache_taps_init(self, params, x, cond, uncond):
         """Zero-filled cache carry shaped like the record branch's taps
         output (CFG doubles the batch the taps cover). `jax.eval_shape`
@@ -264,7 +341,7 @@ class DiffusionSampler:
         if spec is not None:
             return jax.tree_util.tree_map(
                 lambda s: jnp.zeros(s.shape, s.dtype), spec)
-        record_fn, _ = self.cache_fns
+        record_fn = self.cache_fns[0]
         schedule, transform = self.schedule, self.transform
         use_cfg = self.guidance_scale > 0.0 and uncond is not None
 
@@ -288,11 +365,52 @@ class DiffusionSampler:
         return jax.tree_util.tree_map(
             lambda s: jnp.zeros(s.shape, s.dtype), spec)
 
+    def cache_carry_init(self, params, x, cond, uncond):
+        """(taps0, ref0) zero carries for the composed spatial cache —
+        the record_ref branch's taps AND score-reference outputs. Same
+        rules as `cache_taps_init`: `jax.eval_shape` only, memoized per
+        input-shape signature (the abstract trace must not recur on
+        every serving admission), and step 0 of every plan refreshes,
+        so the zeros are never consumed."""
+        def sig(v):
+            return tuple(jax.tree_util.tree_flatten(
+                jax.tree_util.tree_map(
+                    lambda a: (tuple(a.shape), str(a.dtype)), v))[0])
+
+        spec_key = ("composed", sig(x), sig(cond), sig(uncond))
+        spec = self._taps_specs.get(spec_key)
+        if spec is None:
+            fns = self.cache_fns
+            schedule, transform = self.schedule, self.transform
+            use_cfg = self.guidance_scale > 0.0 and uncond is not None
+
+            def probe(x):
+                t_b = jnp.zeros((x.shape[0],), jnp.float32)
+                c_in = bcast_right(transform.input_scale(schedule, t_b),
+                                   x.ndim)
+                x_in, t_in = schedule.transform_inputs(x * c_in, t_b)
+                if use_cfg:
+                    x_in = jnp.concatenate([x_in, x_in], axis=0)
+                    t_in = jnp.concatenate([t_in, t_in], axis=0)
+                    c = jax.tree_util.tree_map(
+                        lambda c_, u_: jnp.concatenate([c_, u_], axis=0),
+                        cond, uncond)
+                else:
+                    c = cond
+                _, taps, ref = fns.record_ref(params, x_in, t_in, c)
+                return taps, ref
+
+            spec = jax.eval_shape(probe, x)
+            self._taps_specs[spec_key] = spec
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), spec)
+
     # -- one compiled program per (steps, shape) ----------------------------
     def _get_program(self, num_steps: int, shape: Tuple[int, ...],
                      start: Optional[float], end: float,
                      inpaint: bool = False):
         cached = self.cache_active
+        spatial = self.spatial_active
         plan_key = self.cache_plan.key() if cached else None
         cache_key = (num_steps, shape, start, end, inpaint, plan_key)
         if cache_key in self._compiled:
@@ -303,18 +421,40 @@ class DiffusionSampler:
                                      schedule=self.schedule)
         # static per-step refresh schedule, folded into the scan as an
         # input row; with the cache off this is absent and the program
-        # below is byte-for-byte the pre-cache one
-        flags = jnp.asarray(self.cache_plan.flags(num_steps)) \
-            if cached else None
+        # below is byte-for-byte the pre-cache one. A composed plan
+        # (ops/spatialcache.py) carries a three-way code row instead of
+        # boolean flags.
+        flags = codes = None
+        if spatial:
+            codes = jnp.asarray(self.cache_plan.step_codes(num_steps))
+        elif cached:
+            flags = jnp.asarray(self.cache_plan.flags(num_steps))
 
         def program(params, x_init, key, cond, uncond, mask=None, known=None):
             denoise = self._denoise_fn(params, cond, uncond)
-            if cached:
+            if spatial:
+                denoise_comp = self._denoise_composed_fn(
+                    params, cond, uncond)
+            elif cached:
                 denoise_taps = self._denoise_taps_fn(params, cond, uncond)
             pairs = jnp.stack([steps[:-1], steps[1:]], axis=1)
 
             def scan_step(carry, inp):
-                if cached:
+                if spatial:
+                    x, rng, state, taps, ref = carry
+                    pair, idx, code = inp
+                    # the box threads BOTH cache carries (taps + score
+                    # reference) through every denoise call of a
+                    # multi-NFE sampler step, all under the one
+                    # per-step scalar switch
+                    carry_box = [taps, ref]
+
+                    def step_denoise(x_, t_):
+                        x0, eps, tp, rf = denoise_comp(
+                            x_, t_, carry_box[0], carry_box[1], code)
+                        carry_box[0], carry_box[1] = tp, rf
+                        return x0, eps
+                elif cached:
                     x, rng, state, taps = carry
                     pair, idx, refresh = inp
                     # higher-order samplers call denoise several times
@@ -349,12 +489,21 @@ class DiffusionSampler:
                     t_b = jnp.full((x.shape[0],), t_next)
                     known_t = self.schedule.add_noise(known, noise, t_b)
                     x_next = mask * x_next + (1.0 - mask) * known_t
+                if spatial:
+                    return (x_next, rng, state, carry_box[0],
+                            carry_box[1]), ()
                 if cached:
                     return (x_next, rng, state, taps_box[0]), ()
                 return (x_next, rng, state), ()
 
             state0 = self.sampler.init_state(x_init)
-            if cached:
+            if spatial:
+                taps0, ref0 = self.cache_carry_init(params, x_init,
+                                                    cond, uncond)
+                (x, _, _, _, _), _ = jax.lax.scan(
+                    scan_step, (x_init, key, state0, taps0, ref0),
+                    (pairs, jnp.arange(num_steps), codes))
+            elif cached:
                 taps0 = self.cache_taps_init(params, x_init, cond, uncond)
                 (x, _, _, _), _ = jax.lax.scan(
                     scan_step, (x_init, key, state0, taps0),
@@ -596,6 +745,84 @@ class DiffusionSampler:
                 (jnp.swapaxes(pairs, 0, 1), jnp.arange(round_steps),
                  flags))
             return x_o, keys_o, state_o, taps_o
+
+        return jax.jit(program)
+
+    def make_spatial_chunk_program(self, round_steps: int):
+        """Continuous-batching round with the COMPOSED timestep x
+        spatial cache (ops/spatialcache.py): the cached-chunk contract
+        with
+
+          codes [round_steps] int32  round-level step codes
+                                     (CODE_REUSE/CODE_SPATIAL/
+                                     CODE_REFRESH)
+          taps  [R, ...] pytree      per-row residual-delta carry
+          refs  [R, ...] pytree      per-row score-reference carry
+
+        and `(x, keys, state, taps, refs)` carries out.
+
+        Same scan-outside / vmap-inside shape as the cached chunk
+        program — the per-step decision must be a SCALAR `lax.switch`
+        (a vmapped switch lowers to select: every branch executes and
+        the speedup is gone). The engine builds the round codes as the
+        per-step MAX over each row's own offset-aligned code schedule:
+        refresh beats spatial beats reuse, so no row ever gets LESS
+        refresh than its plan scheduled — round-mates can only grant
+        extra fidelity. Token selection runs per-row inside the vmap
+        (each row picks its own top-k from its own carries)."""
+        def program(params, x, keys, pairs, n_act, offsets, cond, uncond,
+                    state, codes, taps, refs):
+            def make_step(mode):
+                def step_all(x_c, subs, st, tp, rf, pair_i, i):
+                    def row(x_r, sub, s_r, tp_r, rf_r, pr, off, c, u):
+                        dn = self._denoise_composed_mode_fn(
+                            params, c, u, mode)
+                        carry_box = [tp_r, rf_r]
+
+                        def step_denoise(x_, t_):
+                            x0, eps, tpn, rfn = dn(
+                                x_, t_, carry_box[0], carry_box[1])
+                            carry_box[0], carry_box[1] = tpn, rfn
+                            return x0, eps
+
+                        x_n, s_n = self.sampler.step(
+                            step_denoise, x_r, pr[0], pr[1], sub, s_r,
+                            self.schedule, off + i)
+                        return x_n, s_n, carry_box[0], carry_box[1]
+
+                    return jax.vmap(row)(x_c, subs, st, tp, rf, pair_i,
+                                         offsets, cond, uncond)
+                return step_all
+
+            # branch order == CODE_* values (ops/spatialcache.py)
+            steps_by_code = (make_step("reuse"), make_step("spatial"),
+                             make_step("record"))
+
+            def scan_step(carry, inp):
+                x_c, rngs, st, tp, rf = carry
+                pair_i, i, code = inp
+                # per-row split, same lineage as the uncached row scan
+                both = jax.vmap(jax.random.split)(rngs)
+                rngs_n, subs = both[:, 0], both[:, 1]
+                x_n, s_n, tp_n, rf_n = jax.lax.switch(
+                    code, steps_by_code, x_c, subs, st, tp, rf,
+                    pair_i, i)
+                active = i < n_act
+
+                def sel(a, b):
+                    return jnp.where(bcast_right(active, a.ndim), a, b)
+
+                x_n = sel(x_n, x_c)
+                s_n = jax.tree_util.tree_map(sel, s_n, st)
+                tp_n = jax.tree_util.tree_map(sel, tp_n, tp)
+                rf_n = jax.tree_util.tree_map(sel, rf_n, rf)
+                return (x_n, rngs_n, s_n, tp_n, rf_n), ()
+
+            (x_o, keys_o, state_o, taps_o, refs_o), _ = jax.lax.scan(
+                scan_step, (x, keys, state, taps, refs),
+                (jnp.swapaxes(pairs, 0, 1), jnp.arange(round_steps),
+                 codes))
+            return x_o, keys_o, state_o, taps_o, refs_o
 
         return jax.jit(program)
 

