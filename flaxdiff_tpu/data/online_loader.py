@@ -109,7 +109,10 @@ def default_url_fetcher(timeout: float = 10.0,
         max_attempts=retries + 1, base_delay=0.1, max_delay=2.0)
 
     def attempt(url: str) -> bytes:
-        _res_faults.check("data.fetch")
+        # key=url: per_key fault specs schedule deterministically PER
+        # RECORD ("this URL fails twice then succeeds") instead of only
+        # modeling a lossy network via the site-global counter
+        _res_faults.check("data.fetch", key=url)
         with open_(url, timeout=timeout) as r:
             return r.read()
 

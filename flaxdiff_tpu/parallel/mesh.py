@@ -95,6 +95,14 @@ def mesh_shape_for(mesh: Mesh) -> Dict[str, int]:
     return dict(zip(mesh.axis_names, mesh.devices.shape))
 
 
+def local_data_mesh() -> Mesh:
+    """1-D `'data'` mesh over THIS host's local devices — the per-host
+    world an elastic shrink re-forms around (resilience/elastic.py): a
+    mesh that spanned a lost peer's devices is dead, but the survivor
+    always owns its own chips."""
+    return create_mesh(axes={AXIS_DATA: -1}, devices=jax.local_devices())
+
+
 def local_batch_size(global_batch_size: int) -> int:
     """Per-process batch size for host-sharded input pipelines
     (reference: data/dataloaders.py:297 batch_size // process_count)."""

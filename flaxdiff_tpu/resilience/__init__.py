@@ -18,6 +18,11 @@ ad-hoc per-module handling:
   coordination  multi-host restart as ONE consensus event: step-ledger
                 two-phase checkpoint commits, consensus restore, crash
                 barriers with deadlines (docs/RESILIENCE.md)
+  elastic       LIVE world membership on top of coordination:
+                shrink-to-survive after a lost host, mid-run
+                re-admission of replacement hosts, pod anomaly quorums
+                — plus MemberTransport, which re-scopes the commit
+                rounds to the current member set across transitions
 
 Dependency direction: trainer/ and data/ import resilience; resilience
 imports neither (verify's deep check lazily uses the Checkpointer).
@@ -26,6 +31,7 @@ from .coordination import (
     BarrierTimeout,
     ConsensusError,
     CoordinationError,
+    FileTransport,
     InMemoryTransport,
     JaxDistributedTransport,
     RestartCoordinator,
@@ -33,6 +39,15 @@ from .coordination import (
     Transport,
     agree_epoch,
     default_transport,
+)
+from .elastic import (
+    ElasticConfig,
+    ElasticError,
+    ElasticWorldManager,
+    MemberTransport,
+    QuorumDecision,
+    WorldChange,
+    WorldView,
 )
 from .events import (
     EventLog,
@@ -85,7 +100,15 @@ __all__ = [
     "Transport",
     "InMemoryTransport",
     "JaxDistributedTransport",
+    "FileTransport",
     "RestartCoordinator",
     "agree_epoch",
     "default_transport",
+    "ElasticConfig",
+    "ElasticError",
+    "ElasticWorldManager",
+    "MemberTransport",
+    "QuorumDecision",
+    "WorldChange",
+    "WorldView",
 ]

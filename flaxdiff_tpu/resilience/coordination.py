@@ -81,6 +81,15 @@ class StepLedger:
         {"kind": "commit", "step": 400, "world": 16, "time": ...}
         {"kind": "invalidate", "step": 400, "reason": "...", "time": ...}
         {"kind": "note", "detail": "...", "time": ...}
+        {"kind": "world_changed", "change": "shrink"|"grow"|"evict",
+         "epoch": 2, "members": [...], "world": 3, "step": 400, ...}
+        {"kind": "quorum", "votes": {...}, "decision": "...", ...}
+
+    `world_changed` entries are the committed membership history of an
+    elastic run (resilience/elastic.py): one entry per transition,
+    written by the transition's leader behind the same
+    happens-before-the-ack ordering as commits. Readers that only care
+    about restorable steps (`committed_steps`) skip them.
 
     Only process 0 writes (`record_*`); every host reads. Local writes
     are flushed + fsync'd per entry so a committed step survives a host
@@ -161,6 +170,42 @@ class StepLedger:
     def record_note(self, detail: str) -> None:
         self._append({"kind": "note", "detail": detail, "time": time.time()})
 
+    def record_world_changed(self, change: str, epoch: int,
+                             members: List[int],
+                             step: Optional[int], reason: str = "",
+                             extra: Optional[Dict[str, object]] = None
+                             ) -> None:
+        """One committed membership transition (elastic layer; written
+        only by the transition's leader). `step` is the consensus step
+        the new world (re)starts from; None on a cold world."""
+        entry: Dict[str, object] = {
+            "kind": "world_changed", "change": change, "epoch": int(epoch),
+            "members": [int(m) for m in members], "world": len(members),
+            "step": (int(step) if step is not None else None),
+            "reason": reason, "time": time.time()}
+        if extra:
+            entry.update(extra)
+        self._append(entry)
+
+    def record_quorum(self, votes: Dict[str, bool], decision: str,
+                      step: Optional[int] = None, detail: str = "") -> None:
+        """One pod anomaly-quorum round's verdict (elastic layer,
+        leader-written): who voted anomalous and what the pod decided
+        (`rollback_all` / `evict` / `none`)."""
+        self._append({"kind": "quorum",
+                      "votes": {str(k): bool(v) for k, v in votes.items()},
+                      "decision": decision,
+                      "step": (int(step) if step is not None else None),
+                      "detail": detail, "time": time.time()})
+
+    def world_changes(self) -> List[Dict[str, object]]:
+        """All `world_changed` entries in append order — the world-size
+        timeline diagnose_run/verify_checkpoint render."""
+        return [e for e in self.entries() if e.get("kind") == "world_changed"]
+
+    def quorum_decisions(self) -> List[Dict[str, object]]:
+        return [e for e in self.entries() if e.get("kind") == "quorum"]
+
     def _append(self, entry: Dict[str, object]) -> None:
         line = json.dumps(entry)
         if self._remote:
@@ -206,6 +251,31 @@ class Transport:
         earlier contribution to the same round."""
         raise NotImplementedError
 
+    # -- point reads/writes (the elastic layer's primitives) ----------------
+    # Membership rounds cannot use barrier/allgather: those complete only
+    # when EVERY world member participates, and the whole point of a
+    # membership round is that some member is dead. The elastic layer
+    # instead composes these three: publish a contribution, read one
+    # specific member's contribution with a bounded wait (a dead member
+    # is a None, not a hang), and read/write shared decision keys.
+
+    def poll_json(self, name: str, rank: int, timeout: float = 0.0):
+        """Read `rank`'s `offer_json`/`allgather_json` contribution to
+        gather `name`, waiting up to `timeout`; None when that member
+        never produced it (dead/parked member — NOT an error)."""
+        raise NotImplementedError
+
+    def put_json(self, name: str, obj) -> None:
+        """Direct KV write at `name` (overwrites). Unlike offer_json the
+        key carries NO rank suffix — any member (or a parked joiner)
+        can read it back via get_json without knowing the writer."""
+        raise NotImplementedError
+
+    def get_json(self, name: str, timeout: float = 0.0):
+        """Read a `put_json` key, waiting up to `timeout`; None when
+        absent within the deadline."""
+        raise NotImplementedError
+
 
 class _InMemoryWorld:
     """Shared state behind a set of InMemoryTransports (one per
@@ -241,6 +311,15 @@ class _InMemoryWorld:
                 raise BarrierTimeout(
                     f"key {key!r} not produced within {timeout}s")
             return self._store[key]
+
+    def try_get(self, key: str, timeout: float):
+        """`get` that returns None instead of raising on a missing key —
+        the membership-round read (a dead member is an answer)."""
+        with self._cond:
+            if self._cond.wait_for(lambda: key in self._store,
+                                   max(timeout, 0.0)):
+                return self._store[key]
+            return None
 
 
 class InMemoryTransport(Transport):
@@ -282,6 +361,17 @@ class InMemoryTransport(Transport):
 
     def offer_json(self, name: str, obj) -> None:
         self._world.put(f"ag/{name}/{self.process_index}", json.dumps(obj))
+
+    def poll_json(self, name: str, rank: int, timeout: float = 0.0):
+        raw = self._world.try_get(f"ag/{name}/{rank}", timeout)
+        return None if raw is None else json.loads(raw)
+
+    def put_json(self, name: str, obj) -> None:
+        self._world.put(f"kv/{name}", json.dumps(obj))
+
+    def get_json(self, name: str, timeout: float = 0.0):
+        raw = self._world.try_get(f"kv/{name}", timeout)
+        return None if raw is None else json.loads(raw)
 
 
 def _is_deadline_error(e: Exception) -> bool:
@@ -369,6 +459,135 @@ class JaxDistributedTransport(Transport):
             # older jax: no allow_overwrite kwarg; a duplicate-key error
             # then means our real contribution is already up — fine
             self._client.key_value_set(key, payload)
+
+    def _try_get(self, key: str, timeout: float):
+        try:
+            return self._client.blocking_key_value_get(
+                key, max(int(timeout * 1000), 1))
+        except Exception as e:  # noqa: BLE001 — backend raises
+            # XlaRuntimeError; the deadline case is the "absent" answer
+            if _is_deadline_error(e):
+                return None
+            raise
+
+    def poll_json(self, name: str, rank: int, timeout: float = 0.0):
+        raw = self._try_get(f"{self._ns}/ag/{name}/{rank}", timeout)
+        return None if raw is None else json.loads(raw)
+
+    def put_json(self, name: str, obj) -> None:
+        key = f"{self._ns}/kv/{name}"
+        payload = json.dumps(obj)
+        try:
+            self._client.key_value_set(key, payload, allow_overwrite=True)
+        except TypeError:
+            self._client.key_value_set(key, payload)
+
+    def get_json(self, name: str, timeout: float = 0.0):
+        raw = self._try_get(f"{self._ns}/kv/{name}", timeout)
+        return None if raw is None else json.loads(raw)
+
+
+class FileTransport(Transport):
+    """Transport over a shared directory: barriers are arrival files,
+    the KV store is atomic JSON files (tmp + rename).
+
+    Two properties the elastic chaos suite needs that neither in-memory
+    threads nor `jax.distributed` give on CPU: (1) the world SURVIVES a
+    member's death — a killed process simply never produces its keys,
+    so survivors see bounded Nones instead of a torn coordination
+    service; (2) a process launched LATE (a replacement host) can mount
+    the same directory and park, with no world-size handshake at init
+    time. `jax.distributed` offers neither on CPU: its coordinator dies
+    with process 0 and its world is fixed at initialize().
+
+    Not a performance path — polls at `poll_interval` — but the
+    protocol (and its timeout semantics) is identical to the other
+    backends, so everything proven over it holds over the KV service.
+    """
+
+    def __init__(self, directory: str, rank: int, world: int,
+                 poll_interval: float = 0.02):
+        self.directory = directory
+        self.process_index = int(rank)
+        self.process_count = int(world)
+        self._poll = poll_interval
+        os.makedirs(directory, exist_ok=True)
+
+    # keys become relative file paths; "/" is the hierarchy separator
+    def _path(self, key: str) -> str:
+        safe = "/".join(part.replace("..", "_") or "_"
+                        for part in key.split("/"))
+        return os.path.join(self.directory, safe)
+
+    def _write(self, key: str, text: str) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{self.process_index}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(text)
+        os.replace(tmp, path)       # atomic: readers never see a torn file
+
+    def _read(self, key: str, timeout: float) -> Optional[str]:
+        path = self._path(key)
+        deadline = time.monotonic() + max(timeout, 0.0)
+        while True:
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    return f.read()
+            except OSError:
+                pass
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(self._poll)
+
+    def barrier(self, name: str, timeout: float) -> None:
+        self._write(f"bar/{name}/{self.process_index}", "1")
+        deadline = time.monotonic() + timeout
+        for j in range(self.process_count):
+            remaining = max(deadline - time.monotonic(), 0.0)
+            if self._read(f"bar/{name}/{j}", remaining) is None:
+                raise BarrierTimeout(
+                    f"barrier {name!r}: process {j} absent after "
+                    f"{timeout}s")
+
+    def allgather_json(self, name: str, obj, timeout: float) -> List:
+        self.offer_json(name, obj)
+        deadline = time.monotonic() + timeout
+        out = []
+        for j in range(self.process_count):
+            remaining = max(deadline - time.monotonic(), 0.0)
+            raw = self._read(f"ag/{name}/{j}", remaining)
+            if raw is None:
+                raise BarrierTimeout(
+                    f"allgather {name!r}: process {j} did not "
+                    f"contribute within {timeout}s")
+            out.append(json.loads(raw))
+        return out
+
+    def broadcast_json(self, name: str, obj, timeout: float):
+        if self.process_index == 0:
+            self._write(f"bc/{name}", json.dumps(obj))
+            return obj
+        raw = self._read(f"bc/{name}", timeout)
+        if raw is None:
+            raise BarrierTimeout(
+                f"broadcast {name!r}: no value from process 0 within "
+                f"{timeout}s")
+        return json.loads(raw)
+
+    def offer_json(self, name: str, obj) -> None:
+        self._write(f"ag/{name}/{self.process_index}", json.dumps(obj))
+
+    def poll_json(self, name: str, rank: int, timeout: float = 0.0):
+        raw = self._read(f"ag/{name}/{rank}", timeout)
+        return None if raw is None else json.loads(raw)
+
+    def put_json(self, name: str, obj) -> None:
+        self._write(f"kv/{name}", json.dumps(obj))
+
+    def get_json(self, name: str, timeout: float = 0.0):
+        raw = self._read(f"kv/{name}", timeout)
+        return None if raw is None else json.loads(raw)
 
 
 def default_transport() -> Transport:
@@ -489,21 +708,44 @@ class RestartCoordinator:
             self._mark_lost(f"barrier {name!r}", e)
             raise
 
-    # -- epoch-tagged payloads ----------------------------------------------
-    def _tag(self, value) -> Dict[str, object]:
-        return {"epoch": self.epoch, "value": value}
+    def rebirth(self, epoch: Optional[int] = None) -> None:
+        """Re-arm a coordinator after an elastic world transition: clear
+        `lost`, restart the round sequence at 0 (every surviving member
+        resets identically, and a re-admitted joiner starts at 0 — the
+        transition is the new time zero), and optionally adopt a new
+        epoch. Only the elastic layer calls this: without a committed
+        membership change, un-losing a coordinator would re-enter the
+        hung world the crash barrier just escaped."""
+        self.lost = False
+        self._seq = 0
+        if epoch is not None:
+            self.epoch = int(epoch)
 
-    def _untag(self, payloads: List) -> Optional[List]:
-        """Values from a gathered list of tagged payloads, or None when
-        ANY payload carries a foreign epoch / no tag at all — a late
-        voter from a previous incarnation (or a foreign writer) whose
-        contribution must invalidate the round, not be counted."""
+    # -- epoch/step-tagged payloads ------------------------------------------
+    def _tag(self, value, step: Optional[int] = None) -> Dict[str, object]:
+        tagged: Dict[str, object] = {"epoch": self.epoch, "value": value}
+        if step is not None:
+            tagged["step"] = int(step)
+        return tagged
+
+    def _untag(self, payloads: List, step: Optional[int] = None):
+        """(values, why) from a gathered list of tagged payloads.
+        `values` is None when ANY payload must invalidate the round:
+        `why="epoch"` — a foreign/absent epoch tag (late voter from a
+        previous incarnation); `why="step"` — same epoch but a foreign
+        step tag: two drivers of the SAME incarnation drifted apart
+        (e.g. by a save interval after an asymmetric restore), which
+        must read as a stale-driver rejection, not an opaque
+        non-unanimous vote (docs/RESILIENCE.md "Open items")."""
         values = []
         for p in payloads:
             if not isinstance(p, dict) or p.get("epoch") != self.epoch:
-                return None
+                return None, "epoch"
+            if step is not None and p.get("step") is not None \
+                    and int(p["step"]) != int(step):
+                return None, "step"
             values.append(p.get("value"))
-        return values
+        return values, ""
 
     # -- two-phase commit ----------------------------------------------------
     def commit(self, step: Optional[int], ledger: StepLedger,
@@ -520,12 +762,26 @@ class RestartCoordinator:
         seq = self._next_seq()
         try:
             raw = self.transport.allgather_json(
-                f"commit.{seq}", self._tag(step), self.barrier_timeout)
+                f"commit.{seq}", self._tag(step, step=step),
+                self.barrier_timeout)
         except BarrierTimeout as e:
             self._mark_lost(f"commit vote for step {step}", e)
             raise
-        votes = self._untag(raw)
+        votes, why = self._untag(raw, step=step)
         if votes is None:
+            if why == "step":
+                # same incarnation, different training step: a drifted
+                # sibling driver (asymmetric restore / replayed rank) —
+                # a distinct, diagnosable rejection rather than the
+                # opaque non-unanimous abort it used to surface as
+                self._events.record(
+                    "commit_stale", "ckpt.commit",
+                    detail=f"step drift in commit votes (this driver at "
+                           f"step {step}, gathered {raw}) — a sibling "
+                           f"driver of the same incarnation has drifted "
+                           f"by at least a save interval; step stays "
+                           f"uncommitted", step=step)
+                return None
             self._events.record(
                 "commit_aborted", "ckpt.commit",
                 detail=f"epoch mismatch in commit votes (this epoch "
@@ -577,7 +833,7 @@ class RestartCoordinator:
         except BarrierTimeout as e:
             self._mark_lost("consensus restore gather", e)
             raise
-        sets = self._untag(raw)
+        sets, _ = self._untag(raw)
         if sets is None:
             raise ConsensusError(
                 f"consensus restore saw a payload from another epoch "
@@ -597,7 +853,7 @@ class RestartCoordinator:
         except BarrierTimeout as e:
             self._mark_lost("consensus restore decision", e)
             raise
-        decision = self._untag([raw_decision])
+        decision, _ = self._untag([raw_decision])
         if decision is None:
             raise ConsensusError(
                 f"restore decision carries a foreign epoch (this epoch "

@@ -1,0 +1,633 @@
+"""Elastic world membership: shrink-to-survive, live host re-admission,
+and pod anomaly quorums over the coordination Transport's KV store.
+
+PR 2's coordination layer made restart pod-consistent but kept it
+restart-SHAPED: a lost host turns into `BarrierTimeout` ->
+`coordination_lost` -> checkpoint-and-exit, and a replacement host can
+only join on the next launch (the standing docs/RESILIENCE.md open
+item). At pod scale that converts every host loss into a full-restart
+badput event. This module graduates those paths into LIVE transitions
+(in the spirit of Pulse, arXiv:2606.19163 — recovery decisions made
+from the run's own accounting, not by dying):
+
+  shrink-to-survive   survivors of a missed crash barrier run an
+                      epoch-bumped membership round (presence ->
+                      leader proposal -> unanimous survivor vote ->
+                      ledger `world_changed` entry behind a commit
+                      marker), adopt the smaller world, roll back to
+                      the consensus committed step, and keep training.
+  live re-admission   a replacement host parks on the transport
+                      (`request_join`) and is admitted at the next
+                      commit boundary via the same membership round
+                      (`maybe_admit`); it restores the consensus step
+                      and takes over its data shard mid-run.
+  pod quorum          a host's hard numerics anomaly becomes a pod
+                      VOTE: a majority of anomalous hosts means the
+                      pod is sick (rollback-all to the consensus
+                      step); a minority means those hosts diverged
+                      (evict them, survivors keep training) — never a
+                      unilateral local rollback that silently forks
+                      the fleet.
+
+Why membership rounds cannot ride barrier/allgather: those primitives
+complete only when EVERY world member participates, and a membership
+round exists precisely because some member is dead. Rounds here compose
+the transport's point primitives instead (`offer_json` / `poll_json` /
+`put_json` / `get_json`): a dead member is a bounded None, not a hang.
+
+Safety under asymmetric observation: two survivors may observe
+different responder sets (skewed polls). Adoption requires (a) the
+leader's proposal, (b) a unanimous vote FROM every proposed member,
+and (c) the leader's post-ledger commit marker — a survivor whose view
+disagrees never votes/never sees the marker, the round times out with
+`ElasticError` everywhere, and every caller falls back to the PR-2
+checkpoint-and-exit path. Inconsistent observation degrades to the old
+behavior; it can never adopt divergent worlds.
+
+`MemberTransport` then re-exposes the full Transport API scoped to the
+CURRENT member set, with every key namespaced by the world epoch — so
+the existing `RestartCoordinator`/`Checkpointer` two-phase commits keep
+working unchanged across transitions (stale keys from dead members of
+older epochs are simply unreachable), and `RestartCoordinator.rebirth`
+restarts the round clock at each transition's new time zero.
+
+Dependency direction: trainer/ imports this; this imports only
+resilience siblings.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional
+
+from .coordination import (BarrierTimeout, CoordinationError,
+                           StepLedger, Transport)
+from .events import EventLog, global_event_log
+
+
+class ElasticError(CoordinationError):
+    """A membership/quorum round could not complete (leader vanished
+    mid-round, vote not unanimous, commit marker never appeared). The
+    caller should fall back to the checkpoint-and-exit path — the round
+    design guarantees no member adopted a new world."""
+
+
+@dataclasses.dataclass(frozen=True)
+class WorldView:
+    """This host's view of the current elastic world."""
+    epoch: int                  # bumps once per committed transition
+    rank: int                   # position within `members` (data shard)
+    members: List[int]          # global transport ranks, sorted
+
+    @property
+    def size(self) -> int:
+        return len(self.members)
+
+
+@dataclasses.dataclass(frozen=True)
+class WorldChange:
+    """One committed membership transition."""
+    kind: str                   # "shrink" | "grow" | "evict"
+    epoch: int                  # the NEW world epoch
+    members: List[int]
+    step: Optional[int]         # consensus step the new world runs from
+    removed: List[int]
+    added: List[int]
+    reason: str
+    duration_s: float
+
+    @property
+    def world(self) -> int:
+        return len(self.members)
+
+
+@dataclasses.dataclass(frozen=True)
+class QuorumDecision:
+    """Verdict of one pod anomaly-quorum round."""
+    kind: str                   # "none" | "rollback_all" | "evict" | "evicted"
+    votes: Dict[int, bool] = dataclasses.field(default_factory=dict)
+    step: Optional[int] = None  # rollback_all: the consensus step
+    change: Optional[WorldChange] = None    # evict: the transition
+
+
+@dataclasses.dataclass
+class ElasticConfig:
+    # how long survivors wait for each current member's presence offer
+    # before declaring it dead in a shrink round (per member — live
+    # members answer in one poll interval, so total cost ~= one window
+    # per DEAD member)
+    shrink_window: float = 5.0
+    # proposal / vote / commit-marker deadline within a round
+    vote_timeout: float = 30.0
+    # per-boundary non-blocking peek at parked joiners (leader only)
+    join_poll_timeout: float = 0.05
+    # how long a parked replacement host waits for admission
+    admit_timeout: float = 120.0
+    # below this the world refuses to shrink (caller exits instead)
+    min_world: int = 1
+    # extra counterfactual seconds a checkpoint-and-exit relaunch would
+    # cost beyond what this incarnation measured (scheduler queue time,
+    # container pull, ...) — feeds the badput-reclaimed estimate only
+    restart_cost_estimate: float = 0.0
+
+
+class ElasticWorldManager:
+    """Owns the member list + world epoch and runs the rounds.
+
+    `valid_steps` is each host's input to step consensus — normally
+    `Checkpointer.locally_valid_steps` (committed AND locally intact),
+    falling back to the shared ledger's committed set. All round
+    methods are COLLECTIVE across the live member set and must be
+    called at the same logical points on every member (the same
+    SPMD-driver assumption the commit rounds make).
+    """
+
+    def __init__(self, transport: Transport,
+                 ledger: Optional[StepLedger] = None,
+                 valid_steps: Optional[Callable[[], List[int]]] = None,
+                 config: Optional[ElasticConfig] = None,
+                 event_log: Optional[EventLog] = None,
+                 members: Optional[List[int]] = None):
+        self.transport = transport
+        self.ledger = ledger
+        self.valid_steps = valid_steps
+        self.config = config if config is not None else ElasticConfig()
+        self.rank = transport.process_index
+        self.members: List[int] = (sorted(int(m) for m in members)
+                                   if members is not None
+                                   else list(range(transport.process_count)))
+        self.world_epoch = 0
+        self._event_log = event_log
+        # per-epoch round counters (reset at every transition — the new
+        # epoch namespaces every key, so 0 is always fresh)
+        self._round = 0
+        self._boundary = 0
+        self._qround = 0
+        self._admitted_nonces: set = set()
+        self.last_change: Optional[WorldChange] = None
+
+    # -- views ---------------------------------------------------------------
+    @property
+    def _events(self) -> EventLog:
+        return (self._event_log if self._event_log is not None
+                else global_event_log())
+
+    @property
+    def is_member(self) -> bool:
+        return self.rank in self.members
+
+    @property
+    def member_rank(self) -> int:
+        """Position in the member list — the data-shard index."""
+        return self.members.index(self.rank)
+
+    def world_view(self) -> WorldView:
+        return WorldView(epoch=self.world_epoch, rank=self.member_rank,
+                         members=list(self.members))
+
+    def _local_steps(self) -> List[int]:
+        if self.valid_steps is not None:
+            return sorted(int(s) for s in self.valid_steps())
+        if self.ledger is not None and self.ledger.exists():
+            return self.ledger.committed_steps()
+        return []
+
+    def _adopt(self, kind: str, new_epoch: int, new_members: List[int],
+               step: Optional[int], reason: str, t0: float) -> WorldChange:
+        removed = sorted(set(self.members) - set(new_members))
+        added = sorted(set(new_members) - set(self.members))
+        self.members = sorted(int(m) for m in new_members)
+        self.world_epoch = int(new_epoch)
+        self._round = self._boundary = self._qround = 0
+        change = WorldChange(kind=kind, epoch=self.world_epoch,
+                             members=list(self.members), step=step,
+                             removed=removed, added=added, reason=reason,
+                             duration_s=time.monotonic() - t0)
+        self.last_change = change
+        return change
+
+    # -- shrink-to-survive ---------------------------------------------------
+    def shrink(self, reason: str = "barrier timeout"
+               ) -> Optional[WorldChange]:
+        """Survivors' membership round after a missed crash barrier.
+
+        Returns the committed WorldChange, or None when there is
+        nothing to shrink to (every member answered — the timeout was
+        spurious/transient — or fewer than `min_world` survivors
+        remain); raises ElasticError when the round itself breaks.
+        Every path that returns a change has written the ledger entry
+        and seen the commit marker — adoption is ordered after both.
+        """
+        cfg = self.config
+        t0 = time.monotonic()
+        self._round += 1
+        base = f"el/{self.world_epoch}/s{self._round}"
+        tp = self.transport
+        mine = {"rank": self.rank, "steps": self._local_steps()}
+        tp.offer_json(f"{base}/present", mine)
+        present: Dict[int, Dict] = {self.rank: mine}
+        for r in self.members:
+            if r == self.rank:
+                continue
+            p = tp.poll_json(f"{base}/present", r,
+                             timeout=cfg.shrink_window)
+            if p is not None:
+                present[r] = p
+        survivors = sorted(present)
+        if set(survivors) == set(self.members):
+            self._events.record(
+                "shrink_abandoned", "elastic.shrink",
+                detail=f"every member of {self.members} answered the "
+                       f"presence round — the trigger ({reason}) was "
+                       f"transient, nothing to shrink to")
+            return None
+        if len(survivors) < max(cfg.min_world, 1):
+            self._events.record(
+                "shrink_abandoned", "elastic.shrink",
+                detail=f"only {len(survivors)} survivor(s) "
+                       f"{survivors} < min_world {cfg.min_world}")
+            return None
+        leader = survivors[0]
+        new_epoch = self.world_epoch + 1
+        common = set(present[survivors[0]]["steps"])
+        for r in survivors[1:]:
+            common &= set(present[r]["steps"])
+        step = max(common) if common else None
+        if self.rank == leader:
+            proposal = {"members": survivors, "epoch": new_epoch,
+                        "step": step, "reason": reason}
+            tp.offer_json(f"{base}/proposal", proposal)
+        else:
+            proposal = tp.poll_json(f"{base}/proposal", leader,
+                                    timeout=cfg.vote_timeout)
+            if proposal is None:
+                raise ElasticError(
+                    f"shrink round {base}: no proposal from leader "
+                    f"{leader} within {cfg.vote_timeout}s")
+        accept = (self.rank in proposal["members"]
+                  and int(proposal["epoch"]) == new_epoch
+                  and (proposal["step"] is None
+                       or proposal["step"] in mine["steps"]))
+        tp.offer_json(f"{base}/vote",
+                      {"rank": self.rank, "accept": accept})
+        if not accept:
+            raise ElasticError(
+                f"shrink round {base}: this host cannot accept "
+                f"proposal {proposal} (local steps {mine['steps']})")
+        for r in proposal["members"]:
+            v = tp.poll_json(f"{base}/vote", r, timeout=cfg.vote_timeout)
+            if v is None or not v.get("accept"):
+                raise ElasticError(
+                    f"shrink round {base}: member {r} vote "
+                    f"{'missing' if v is None else 'rejected'} — "
+                    f"no unanimous survivor vote")
+        members = [int(m) for m in proposal["members"]]
+        step = (int(proposal["step"])
+                if proposal["step"] is not None else None)
+        if self.rank == leader:
+            if self.ledger is not None:
+                removed = sorted(set(self.members) - set(members))
+                self.ledger.record_world_changed(
+                    "shrink", new_epoch, members, step, reason=reason,
+                    extra={"removed": removed})
+            tp.put_json(f"{base}/committed", {"epoch": new_epoch})
+        elif tp.get_json(f"{base}/committed",
+                         timeout=cfg.vote_timeout) is None:
+            raise ElasticError(
+                f"shrink round {base}: commit marker never appeared")
+        change = self._adopt("shrink", new_epoch, members, step,
+                             reason, t0)
+        self._events.record(
+            "world_shrunk", "elastic.world",
+            detail=f"epoch {change.epoch}: {change.removed} lost, "
+                   f"world {len(self.members)} survivor(s) "
+                   f"{self.members} continue from step {step}",
+            step=step)
+        return change
+
+    # -- live re-admission ---------------------------------------------------
+    def request_join(self, timeout: Optional[float] = None) -> WorldChange:
+        """Parked replacement host: publish a join request and wait for
+        the admission decision written by the incumbent world's leader
+        at a commit boundary. On admission this manager adopts the
+        grown world; the caller then restores the decision's consensus
+        step and enters the training loop in lockstep."""
+        tp = self.transport
+        nonce = f"{self.rank}-{time.time_ns()}"
+        tp.put_json(f"el/join/{self.rank}",
+                    {"rank": self.rank, "nonce": nonce,
+                     "time": time.time()})
+        self._events.record("join_requested", "elastic.join",
+                            detail=f"host {self.rank} parked, awaiting "
+                                   f"admission (nonce {nonce})")
+        deadline = (timeout if timeout is not None
+                    else self.config.admit_timeout)
+        decision = tp.get_json(f"el/admit/{self.rank}/{nonce}",
+                               timeout=deadline)
+        if decision is None:
+            raise ElasticError(
+                f"host {self.rank}: no admission decision within "
+                f"{deadline}s (is the incumbent world reaching commit "
+                f"boundaries?)")
+        t0 = time.monotonic()
+        change = self._adopt("grow", int(decision["epoch"]),
+                             [int(m) for m in decision["members"]],
+                             (int(decision["step"])
+                              if decision["step"] is not None else None),
+                             "re-admitted", t0)
+        self._events.record(
+            "world_grown", "elastic.world",
+            detail=f"host {self.rank} admitted at epoch {change.epoch}: "
+                   f"world {change.world} from step {change.step}",
+            step=change.step)
+        return change
+
+    def maybe_admit(self, current_step: Optional[int] = None
+                    ) -> Optional[WorldChange]:
+        """Commit-boundary admission check — COLLECTIVE across members.
+        The leader peeks at parked join requests (bounded, non-blocking
+        for all practical purposes) and broadcasts the candidate (or
+        None) for this boundary; a candidate triggers the same
+        propose/vote/ledger/marker round as shrink, grown by one. The
+        joiner is handed the decision under its request nonce.
+        `current_step` (the step just committed) becomes the consensus
+        step the joiner restores."""
+        cfg = self.config
+        self._boundary += 1
+        tp = self.transport
+        leader = self.members[0]
+        base = f"el/{self.world_epoch}/a{self._boundary}"
+        if self.rank == leader:
+            joiner, nonce = None, None
+            for r in range(tp.process_count):
+                if r in self.members:
+                    continue
+                req = tp.get_json(f"el/join/{r}",
+                                  timeout=cfg.join_poll_timeout)
+                if req is not None \
+                        and req.get("nonce") not in self._admitted_nonces:
+                    joiner, nonce = r, req.get("nonce")
+                    break
+            tp.put_json(f"{base}/cand", {"joiner": joiner, "nonce": nonce})
+        cand = tp.get_json(f"{base}/cand", timeout=cfg.vote_timeout)
+        if cand is None:
+            raise ElasticError(
+                f"admission boundary {base}: no candidate broadcast "
+                f"from leader {leader}")
+        if cand["joiner"] is None:
+            return None
+        t0 = time.monotonic()
+        joiner = int(cand["joiner"])
+        new_epoch = self.world_epoch + 1
+        new_members = sorted(set(self.members) | {joiner})
+        step = (int(current_step) if current_step is not None
+                else (self._local_steps() or [None])[-1])
+        accept = joiner not in self.members
+        tp.offer_json(f"{base}/vote", {"rank": self.rank, "accept": accept})
+        for r in self.members:
+            v = tp.poll_json(f"{base}/vote", r, timeout=cfg.vote_timeout)
+            if v is None or not v.get("accept"):
+                raise ElasticError(
+                    f"admission round {base}: member {r} vote "
+                    f"{'missing' if v is None else 'rejected'}")
+        if self.rank == leader:
+            if self.ledger is not None:
+                self.ledger.record_world_changed(
+                    "grow", new_epoch, new_members, step,
+                    reason=f"re-admitted host {joiner}",
+                    extra={"added": [joiner]})
+            self._admitted_nonces.add(cand["nonce"])
+            tp.put_json(f"el/admit/{joiner}/{cand['nonce']}",
+                        {"members": new_members, "epoch": new_epoch,
+                         "step": step})
+            tp.put_json(f"{base}/committed", {"epoch": new_epoch})
+        elif tp.get_json(f"{base}/committed",
+                         timeout=cfg.vote_timeout) is None:
+            raise ElasticError(
+                f"admission round {base}: commit marker never appeared")
+        change = self._adopt("grow", new_epoch, new_members, step,
+                             f"re-admitted host {joiner}", t0)
+        self._events.record(
+            "world_grown", "elastic.world",
+            detail=f"epoch {change.epoch}: host {joiner} re-admitted, "
+                   f"world {change.world} continues from step {step}",
+            step=step)
+        return change
+
+    # -- pod anomaly quorum --------------------------------------------------
+    def quorum_round(self, anomalous: bool,
+                     step: Optional[int] = None) -> QuorumDecision:
+        """COLLECTIVE anomaly vote (every member calls this at the same
+        cadence step with its local hard-anomaly verdict).
+
+        Decision rule: anomalous MAJORITY (> world/2) means the pod is
+        sick — every member rolls back to the consensus committed step;
+        an anomalous MINORITY means those hosts diverged — they are
+        evicted via a membership transition and the survivors keep
+        training untouched. Ties are a majority of healthy hosts, so a
+        lone anomalous host in a world of two is evicted, not obeyed.
+        """
+        cfg = self.config
+        if len(self.members) == 1:
+            # solo world: local verdict IS the quorum
+            if not anomalous:
+                return QuorumDecision("none", votes={self.rank: False})
+            if self.ledger is not None:
+                self.ledger.record_quorum({str(self.rank): True},
+                                          "rollback_all", step=step,
+                                          detail="solo world")
+            steps = self._local_steps()
+            consensus = steps[-1] if steps else None
+            self._events.record(
+                "quorum_rollback", "elastic.quorum",
+                detail=f"solo world: local hard anomaly rolls back to "
+                       f"step {consensus}", step=step)
+            return QuorumDecision("rollback_all",
+                                  votes={self.rank: True}, step=consensus)
+        self._qround += 1
+        tp = self.transport
+        base = f"el/{self.world_epoch}/q{self._qround}"
+        tp.offer_json(f"{base}/vote",
+                      {"rank": self.rank, "anomalous": bool(anomalous),
+                       "steps": self._local_steps()})
+        votes: Dict[int, bool] = {}
+        step_sets: Dict[int, set] = {}
+        for r in self.members:
+            v = tp.poll_json(f"{base}/vote", r, timeout=cfg.vote_timeout)
+            if v is None:
+                raise ElasticError(
+                    f"quorum round {base}: member {r} never voted")
+            votes[r] = bool(v.get("anomalous"))
+            step_sets[r] = set(v.get("steps") or ())
+        bad = sorted(r for r, a in votes.items() if a)
+        leader = self.members[0]
+        if not bad:
+            return QuorumDecision("none", votes=votes)
+        if len(bad) * 2 > len(self.members):
+            common = step_sets[self.members[0]]
+            for r in self.members[1:]:
+                common &= step_sets[r]
+            consensus = max(common) if common else None
+            if self.rank == leader and self.ledger is not None:
+                self.ledger.record_quorum(
+                    {str(r): a for r, a in votes.items()}, "rollback_all",
+                    step=consensus,
+                    detail=f"{len(bad)}/{len(self.members)} anomalous")
+            self._events.record(
+                "quorum_rollback", "elastic.quorum",
+                detail=f"pod-sick majority {bad} of {self.members}: "
+                       f"rolling every member back to step {consensus}",
+                step=step)
+            return QuorumDecision("rollback_all", votes=votes,
+                                  step=consensus)
+        # minority diverged: evict via a membership transition
+        t0 = time.monotonic()
+        survivors = [r for r in self.members if r not in bad]
+        new_epoch = self.world_epoch + 1
+        new_leader = survivors[0]
+        if self.rank == new_leader:
+            if self.ledger is not None:
+                self.ledger.record_quorum(
+                    {str(r): a for r, a in votes.items()}, "evict",
+                    step=step,
+                    detail=f"outlier minority {bad} evicted")
+                self.ledger.record_world_changed(
+                    "evict", new_epoch, survivors, step,
+                    reason=f"quorum evicted {bad}",
+                    extra={"removed": bad})
+            tp.put_json(f"{base}/committed", {"epoch": new_epoch})
+        if self.rank in bad:
+            self._events.record(
+                "quorum_evicted", "elastic.quorum",
+                detail=f"this host's anomaly was an outlier "
+                       f"({bad} of {self.members}); evicted — the "
+                       f"survivors continue without it",
+                step=step)
+            return QuorumDecision("evicted", votes=votes)
+        if self.rank != new_leader and tp.get_json(
+                f"{base}/committed", timeout=cfg.vote_timeout) is None:
+            raise ElasticError(
+                f"quorum round {base}: eviction commit marker never "
+                f"appeared")
+        change = self._adopt("evict", new_epoch, survivors, step,
+                             f"quorum evicted {bad}", t0)
+        self._events.record(
+            "quorum_evicted", "elastic.quorum",
+            detail=f"epoch {change.epoch}: outlier(s) {bad} evicted, "
+                   f"world {change.world} continues untouched",
+            step=step)
+        return QuorumDecision("evict", votes=votes, change=change)
+
+    # -- accounting ----------------------------------------------------------
+    def reclaimed_estimate(self, step: Optional[int], transition_s: float,
+                           goodput=None) -> float:
+        """Badput reclaimed vs. the checkpoint-and-exit counterfactual,
+        from the run's OWN accounting: a relaunch would redo the work
+        since the consensus step's commit (its wall-age in the ledger),
+        re-pay this incarnation's measured startup badput (compile +
+        restart buckets), and pay the configured scheduler relaunch
+        overhead — minus what the live transition actually cost."""
+        lost = 0.0
+        if self.ledger is not None and step is not None:
+            commits = [float(e.get("time", 0.0))
+                       for e in self.ledger.entries()
+                       if e.get("kind") == "commit"
+                       and e.get("step") == step]
+            if commits:
+                lost = max(time.time() - max(commits), 0.0)
+        startup = 0.0
+        if goodput is not None:
+            _, bad = goodput.raw_counters()
+            startup = bad.get("compile", 0.0) + bad.get("restart", 0.0)
+        return max(lost + startup + self.config.restart_cost_estimate
+                   - max(transition_s, 0.0), 0.0)
+
+
+class MemberTransport(Transport):
+    """The full Transport API scoped to the manager's CURRENT members.
+
+    `RestartCoordinator`/`Checkpointer` two-phase commits keep working
+    unchanged across elastic transitions: ranks are member-relative
+    (the leader is always process 0), every key is namespaced by the
+    world epoch (keys from dead members of older epochs are
+    unreachable), and collectives wait only on live members. Reads the
+    member list at CALL time, so a committed transition re-scopes every
+    subsequent round without rebuilding anything.
+    """
+
+    def __init__(self, manager: ElasticWorldManager):
+        self._m = manager
+
+    @property
+    def process_index(self) -> int:     # type: ignore[override]
+        return self._m.member_rank
+
+    @property
+    def process_count(self) -> int:     # type: ignore[override]
+        return len(self._m.members)
+
+    def _scoped(self, name: str) -> str:
+        return f"m{self._m.world_epoch}/{name}"
+
+    def _members(self) -> List[int]:
+        if not self._m.is_member:
+            raise CoordinationError(
+                f"host {self._m.rank} is not a member of the elastic "
+                f"world {self._m.members} (evicted?)")
+        return list(self._m.members)
+
+    def barrier(self, name: str, timeout: float) -> None:
+        members = self._members()
+        scoped = self._scoped(name)
+        self._m.transport.offer_json(f"bar/{scoped}", 1)
+        deadline = time.monotonic() + timeout
+        for r in members:
+            remaining = max(deadline - time.monotonic(), 0.0)
+            if self._m.transport.poll_json(f"bar/{scoped}", r,
+                                           timeout=remaining) is None:
+                raise BarrierTimeout(
+                    f"member barrier {name!r}: member {r} absent "
+                    f"after {timeout}s")
+
+    def allgather_json(self, name: str, obj, timeout: float) -> List:
+        members = self._members()
+        scoped = self._scoped(name)
+        self._m.transport.offer_json(scoped, obj)
+        deadline = time.monotonic() + timeout
+        out = []
+        for r in members:
+            remaining = max(deadline - time.monotonic(), 0.0)
+            p = self._m.transport.poll_json(scoped, r, timeout=remaining)
+            if p is None:
+                raise BarrierTimeout(
+                    f"member allgather {name!r}: member {r} did not "
+                    f"contribute within {timeout}s")
+            out.append(p)
+        return out
+
+    def broadcast_json(self, name: str, obj, timeout: float):
+        members = self._members()
+        scoped = self._scoped(name)
+        if self._m.rank == members[0]:
+            self._m.transport.put_json(f"bc/{scoped}", obj)
+            return obj
+        got = self._m.transport.get_json(f"bc/{scoped}", timeout=timeout)
+        if got is None:
+            raise BarrierTimeout(
+                f"member broadcast {name!r}: no value from leader "
+                f"{members[0]} within {timeout}s")
+        return got
+
+    def offer_json(self, name: str, obj) -> None:
+        self._m.transport.offer_json(self._scoped(name), obj)
+
+    def poll_json(self, name: str, rank: int, timeout: float = 0.0):
+        # `rank` here is member-relative, matching process_index
+        return self._m.transport.poll_json(self._scoped(name),
+                                           self._members()[rank], timeout)
+
+    def put_json(self, name: str, obj) -> None:
+        self._m.transport.put_json(self._scoped(name), obj)
+
+    def get_json(self, name: str, timeout: float = 0.0):
+        return self._m.transport.get_json(self._scoped(name), timeout)

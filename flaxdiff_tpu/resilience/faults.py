@@ -75,6 +75,16 @@ class FaultSpec:
            sleeps `delay` seconds, "flag" -> no raise; `check` returns
            True (caller-interpreted, e.g. step.nan / host.sigterm).
     delay: stall duration for error="stall".
+    per_key: interpret `at` against a PER-KEY hit counter instead of
+           the site-global one — sites that pass `check(site, key=url)`
+           (the `data.fetch` site passes the URL) can then model
+           "THIS url fails on its first two attempts, then succeeds"
+           (`at=(1, 2), per_key=True`), which the global counter never
+           could: interleaved fetches of other URLs advance it
+           unpredictably, so a global `at` models only a lossy network.
+           Occurrences without a key never fire a per_key spec.
+    match: only consider keys containing this substring (per_key mode;
+           empty matches every key) — arm one specific URL.
     """
     site: str
     at: Tuple[int, ...] = ()
@@ -82,10 +92,14 @@ class FaultSpec:
     times: int = 0
     error: str = "io"
     delay: float = 0.0
+    per_key: bool = False
+    match: str = ""
 
     def as_dict(self) -> Dict[str, object]:
         return {"site": self.site, "at": list(self.at), "prob": self.prob,
-                "times": self.times, "error": self.error, "delay": self.delay}
+                "times": self.times, "error": self.error,
+                "delay": self.delay, "per_key": self.per_key,
+                "match": self.match}
 
     @classmethod
     def from_dict(cls, d: Dict[str, object]) -> "FaultSpec":
@@ -94,7 +108,9 @@ class FaultSpec:
                    prob=float(d.get("prob", 0.0)),
                    times=int(d.get("times", 0)),
                    error=str(d.get("error", "io")),
-                   delay=float(d.get("delay", 0.0)))
+                   delay=float(d.get("delay", 0.0)),
+                   per_key=bool(d.get("per_key", False)),
+                   match=str(d.get("match", "")))
 
 
 class FaultPlan:
@@ -107,6 +123,7 @@ class FaultPlan:
         for spec in specs:
             self._specs.setdefault(spec.site, []).append(spec)
         self._hits: Dict[str, int] = {}
+        self._key_hits: Dict[Tuple[str, str], int] = {}
         self._fired: Dict[int, int] = {}    # id(spec) -> firings
         self._rng = np.random.default_rng(seed)
 
@@ -132,16 +149,33 @@ class FaultPlan:
         with self._lock:
             return self._hits.get(site, 0)
 
-    def _poll(self, site: str) -> Optional[FaultSpec]:
-        """Count one occurrence of `site`; return the spec that fires, if
-        any. Thread-safe and deterministic given the call sequence."""
+    def key_hits(self, site: str, key: str) -> int:
+        with self._lock:
+            return self._key_hits.get((site, key), 0)
+
+    def _poll(self, site: str,
+              key: Optional[str] = None) -> Optional[FaultSpec]:
+        """Count one occurrence of `site` (and of `(site, key)` when a
+        key is given); return the spec that fires, if any. Thread-safe
+        and deterministic given the call sequence — per_key specs are
+        additionally deterministic against interleaving, because each
+        key carries its own counter."""
         with self._lock:
             n = self._hits.get(site, 0) + 1
             self._hits[site] = n
+            nk = 0
+            if key is not None:
+                nk = self._key_hits.get((site, key), 0) + 1
+                self._key_hits[(site, key)] = nk
             for spec in self._specs.get(site, ()):
                 if spec.times and self._fired.get(id(spec), 0) >= spec.times:
                     continue
-                fire = n in spec.at
+                if spec.per_key:
+                    if key is None or (spec.match and spec.match not in key):
+                        continue
+                    fire = nk in spec.at
+                else:
+                    fire = n in spec.at
                 if not fire and spec.prob > 0:
                     fire = bool(self._rng.random() < spec.prob)
                 if fire:
@@ -149,15 +183,21 @@ class FaultPlan:
                     return spec
         return None
 
-    def check(self, site: str, step: Optional[int] = None) -> bool:
+    def check(self, site: str, step: Optional[int] = None,
+              key: Optional[str] = None) -> bool:
         """One occurrence of `site`. Raises for error faults; returns
         True for "flag" faults (caller decides what failing means);
-        False when nothing fires."""
-        spec = self._poll(site)
+        False when nothing fires. `key` identifies the record within
+        the site (the fetch URL) so `per_key` specs can schedule
+        deterministically per record."""
+        spec = self._poll(site, key=key)
         if spec is None:
             return False
         record_event("fault_injected", site,
-                     detail=f"error={spec.error} hit={self.hits(site)}",
+                     detail=f"error={spec.error} hit={self.hits(site)}"
+                            + (f" key={key} key_hit="
+                               f"{self.key_hits(site, key)}"
+                               if key is not None and spec.per_key else ""),
                      step=step)
         if spec.error == "io":
             raise InjectedFault(f"injected fault at {site} "
@@ -219,10 +259,12 @@ def active_plan() -> Optional[FaultPlan]:
     return _ACTIVE
 
 
-def check(site: str, step: Optional[int] = None) -> bool:
+def check(site: str, step: Optional[int] = None,
+          key: Optional[str] = None) -> bool:
     """Module-level site barrier: no-op without an active plan."""
     plan = active_plan()
-    return plan.check(site, step=step) if plan is not None else False
+    return plan.check(site, step=step, key=key) if plan is not None \
+        else False
 
 
 def maybe_stall(site: str, step: Optional[int] = None) -> float:

@@ -44,21 +44,27 @@ class StepReport:
 
 def annotate_ledger(directory: str, reports: List[StepReport]) -> Dict:
     """Attach per-step ledger commit status to `reports` and return a
-    summary dict ({present, path, committed_steps, entries}) for the
-    fleet-debugging CLI. With no ledger file every `committed` stays
-    None (pre-coordination checkpoint dir)."""
+    summary dict ({present, path, committed_steps, entries,
+    world_changes, quorum_decisions}) for the fleet-debugging CLI —
+    elastic membership transitions round-trip through the `--json`
+    report so a fleet diff shows WHICH world committed each step. With
+    no ledger file every `committed` stays None (pre-coordination
+    checkpoint dir)."""
     from .coordination import StepLedger
     ledger = StepLedger(directory)
     if not ledger.exists():
         return {"present": False, "path": ledger.path,
-                "committed_steps": [], "entries": 0}
+                "committed_steps": [], "entries": 0,
+                "world_changes": [], "quorum_decisions": []}
     committed = set(ledger.committed_steps())
     for r in reports:
         if r.step >= 0:
             r.committed = r.step in committed
     return {"present": True, "path": ledger.path,
             "committed_steps": sorted(committed),
-            "entries": len(ledger.entries())}
+            "entries": len(ledger.entries()),
+            "world_changes": ledger.world_changes(),
+            "quorum_decisions": ledger.quorum_decisions()}
 
 
 def _step_dir(directory: str, step: int) -> str:

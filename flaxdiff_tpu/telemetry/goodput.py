@@ -50,8 +50,16 @@ class GoodputLedger:
         self._lock = threading.Lock()
         self._productive = 0.0
         self._badput: Dict[str, float] = {}
+        # ESTIMATED badput avoided by live recovery (elastic transitions
+        # vs. their checkpoint-and-exit counterfactual). A separate
+        # account, NOT part of the productive+badput=wall-clock
+        # invariant: reclaimed seconds never happened — they are what a
+        # restart WOULD have cost — so adding them to either side would
+        # corrupt the attribution closure.
+        self._reclaimed: Dict[str, float] = {}
         self._prior_productive = 0.0
         self._prior_badput: Dict[str, float] = {}
+        self._prior_reclaimed: Dict[str, float] = {}
         self.incarnation = 1
         if path is not None and os.path.exists(path):
             try:
@@ -61,6 +69,9 @@ class GoodputLedger:
                 prior_badput = {
                     str(k): float(v)
                     for k, v in dict(prior.get("badput_s", {})).items()}
+                prior_reclaimed = {
+                    str(k): float(v)
+                    for k, v in dict(prior.get("reclaimed_s", {})).items()}
                 incarnation = int(prior.get("incarnations", 0)) + 1
             except (json.JSONDecodeError, ValueError, TypeError, OSError):
                 # a torn write from a crashed incarnation: start a fresh
@@ -72,6 +83,7 @@ class GoodputLedger:
             else:
                 self._prior_productive = prior_productive
                 self._prior_badput = prior_badput
+                self._prior_reclaimed = prior_reclaimed
                 self.incarnation = incarnation
 
     # -- recording -----------------------------------------------------------
@@ -85,6 +97,17 @@ class GoodputLedger:
             return
         with self._lock:
             self._badput[bucket] = self._badput.get(bucket, 0.0) + s
+
+    def record_reclaimed(self, bucket: str, seconds: float) -> None:
+        """Credit an elastic transition's estimated badput savings vs.
+        its checkpoint-and-exit counterfactual
+        (`ElasticWorldManager.reclaimed_estimate`). Kept OUT of the
+        productive/badput closure — see `_reclaimed` above."""
+        s = max(float(seconds), 0.0)
+        if s == 0.0:
+            return
+        with self._lock:
+            self._reclaimed[bucket] = self._reclaimed.get(bucket, 0.0) + s
 
     def reattribute(self, bucket: str, seconds: float) -> float:
         """Move up to `seconds` from a badput bucket into productive
@@ -126,10 +149,13 @@ class GoodputLedger:
         with self._lock:
             productive = self._productive
             badput = dict(self._badput)
+            reclaimed = dict(self._reclaimed)
         if cumulative:
             productive += self._prior_productive
             for k, v in self._prior_badput.items():
                 badput[k] = badput.get(k, 0.0) + v
+            for k, v in self._prior_reclaimed.items():
+                reclaimed[k] = reclaimed.get(k, 0.0) + v
         bad_total = sum(badput.values())
         total = productive + bad_total
         return {
@@ -137,6 +163,8 @@ class GoodputLedger:
             "productive_s": productive,
             "badput_s": badput,
             "badput_total_s": bad_total,
+            "reclaimed_s": reclaimed,
+            "reclaimed_total_s": sum(reclaimed.values()),
             "total_s": total,
             "goodput_fraction": (productive / total) if total > 0 else None,
         }
@@ -151,6 +179,10 @@ class GoodputLedger:
             out["goodput/fraction"] = t["goodput_fraction"]
         for k, v in t["badput_s"].items():
             out[f"goodput/badput/{k}_s"] = v
+        if t["reclaimed_total_s"]:
+            out["goodput/reclaimed_s"] = t["reclaimed_total_s"]
+            for k, v in t["reclaimed_s"].items():
+                out[f"goodput/reclaimed/{k}_s"] = v
         return out
 
     # -- persistence ---------------------------------------------------------
@@ -164,6 +196,7 @@ class GoodputLedger:
         payload = {"incarnations": self.incarnation,
                    "productive_s": t["productive_s"],
                    "badput_s": t["badput_s"],
+                   "reclaimed_s": t["reclaimed_s"],
                    "updated": time.time()}
         os.makedirs(os.path.dirname(os.path.abspath(self.path)) or ".",
                     exist_ok=True)

@@ -158,11 +158,19 @@ class AutoEncoderTrainer:
                              scaling_factor=cfg["scaling_factor"])
 
     def measure_latent_scale(self, data: Iterator[PyTree],
-                             num_batches: int = 8) -> float:
+                             num_batches: int = 8,
+                             use_ema: bool = True) -> float:
         """SD convention: scaling_factor = 1 / std(encoder latents), so
-        scaled latents are ~unit variance for the diffusion prior."""
+        scaled latents are ~unit variance for the diffusion prior.
+
+        `use_ema` must match the `trained_vae` export the factor will
+        be applied to (both default to the EMA weights). Measuring on
+        one weight set and scaling the other breaks the unit-variance
+        construction: with the short-horizon EMA lag of a young run the
+        mismatch is large (measured ~0.27 std instead of ~1.0 on the
+        tier-1 roundtrip test — the historical seed failure)."""
         stds = []
-        vae = self.trained_vae(use_ema=False, scaling_factor=1.0)
+        vae = self.trained_vae(use_ema=use_ema, scaling_factor=1.0)
         for _ in range(num_batches):
             x = jnp.asarray(next(data)["sample"])
             x = (normalize_images(x) if self.config.normalize

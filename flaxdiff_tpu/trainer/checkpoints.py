@@ -62,7 +62,8 @@ class Checkpointer:
                  save_retry: Optional[RetryPolicy] = DEFAULT_SAVE_RETRY,
                  event_log: Optional[_events.EventLog] = None,
                  coordinator: Optional[RestartCoordinator] = None,
-                 use_ledger: Optional[bool] = None):
+                 use_ledger: Optional[bool] = None,
+                 ledger_directory: Optional[str] = None):
         directory = os.path.abspath(os.path.expanduser(directory)) \
             if "://" not in directory else directory
         self._mgr = ocp.CheckpointManager(
@@ -78,7 +79,13 @@ class Checkpointer:
         self._coordinator = coordinator
         if use_ledger is None:
             use_ledger = coordinator is not None
-        self._ledger = StepLedger(str(self._mgr.directory)) \
+        # `ledger_directory` splits the CONTROL ledger from the data
+        # shards: elastic worlds where each host writes a host-local
+        # checkpoint directory still share ONE ledger (the membership +
+        # commit history must have a single source of truth)
+        self._ledger = StepLedger(ledger_directory
+                                  if ledger_directory is not None
+                                  else str(self._mgr.directory)) \
             if use_ledger else None
         self._pending_commit: Optional[int] = None
         self.last_save_result: str = "none"

@@ -211,16 +211,25 @@ class DiffusionTrainer:
                  autoencoder: Optional[Any] = None,
                  null_cond: Optional[PyTree] = None,
                  checkpointer: Optional[Any] = None,
-                 telemetry: Optional[Any] = None):
+                 telemetry: Optional[Any] = None,
+                 elastic: Optional[Any] = None):
         """apply_fn(params, x_t, t, cond) -> raw output;
         init_fn(key) -> params (closes over example input shapes).
 
         `telemetry`: a telemetry.Telemetry hub; None falls back to the
         process-global hub at fit time (disabled by default, so
-        un-instrumented runs keep fully-async step dispatch)."""
+        un-instrumented runs keep fully-async step dispatch).
+
+        `elastic`: a resilience.ElasticWorldManager. The fit loop then
+        survives a lost peer by shrinking the world (instead of
+        checkpoint-and-exit on coordination_lost), admits parked
+        replacement hosts at commit boundaries, and turns hard
+        numerics anomalies into pod quorum votes
+        (docs/RESILIENCE.md "Elastic world")."""
         self.mesh = mesh
         self.config = config
         self.telemetry = telemetry
+        self.elastic = elastic
         self.schedule = schedule
         self.transform = transform
         self.checkpointer = checkpointer
@@ -322,28 +331,14 @@ class DiffusionTrainer:
 
         self._batch_axis = batch_spec(mesh)
 
-        self._step = jax.jit(
-            step_fn,
-            donate_argnums=(0,),
-            out_shardings=(self.state_shardings, NamedSharding(mesh, P())),
-        )
-        # the monitored twin: same program + in-graph numerics aux
-        # (replicated scalars). Compiled separately so off-cadence steps
-        # keep running the EXACT unmonitored program.
-        self._step_monitored = None
-        if monitored_step_fn is not None:
-            self._step_monitored = jax.jit(
-                monitored_step_fn,
-                donate_argnums=(0,),
-                out_shardings=(self.state_shardings,
-                               NamedSharding(mesh, P()),
-                               NamedSharding(mesh, P())),
-            )
-        self._probe = None      # lazily-jitted NaN-provenance pass
+        # kept so an elastic mesh rebuild can re-jit the same programs
+        # against the new mesh/shardings (_compile_programs)
+        self._step_fn = step_fn
+        self._monitored_fn = monitored_step_fn
+        self._compile_programs()
 
         self.best_loss = float("inf")
         self.best_state: Optional[TrainState] = None
-        self._step_flops: Dict[Any, Optional[float]] = {}
 
         if self._param_template is not None and checkpointer is not None:
             # flat-state checkpoints are unreadable without the template
@@ -372,6 +367,90 @@ class DiffusionTrainer:
             warnings.warn(f"could not write {path}: {e}; flat-params "
                           "checkpoints need it for inference restore",
                           stacklevel=2)
+
+    def _compile_programs(self):
+        """(Re)bind the jitted step programs to the CURRENT mesh and
+        state shardings — at construction, and again after an elastic
+        mesh rebuild (the old programs bake in the old device
+        assignment)."""
+        mesh = self.mesh
+        self._step = jax.jit(
+            self._step_fn,
+            donate_argnums=(0,),
+            out_shardings=(self.state_shardings, NamedSharding(mesh, P())),
+        )
+        # the monitored twin: same program + in-graph numerics aux
+        # (replicated scalars). Compiled separately so off-cadence steps
+        # keep running the EXACT unmonitored program.
+        self._step_monitored = None
+        if self._monitored_fn is not None:
+            self._step_monitored = jax.jit(
+                self._monitored_fn,
+                donate_argnums=(0,),
+                out_shardings=(self.state_shardings,
+                               NamedSharding(mesh, P()),
+                               NamedSharding(mesh, P())),
+            )
+        self._probe = None      # lazily-jitted NaN-provenance pass
+        self._step_flops: Dict[Any, Optional[float]] = {}
+
+    # -- elastic world transitions -------------------------------------------
+    def _rebuild_world_mesh(self, force: bool = False) -> bool:
+        """Rebuild a 1-D `'data'` mesh over THIS host's local devices
+        and re-shard/re-jit around it (elastic shrink helper).
+
+        After a peer is lost, a mesh that spanned its devices is dead —
+        every collective over it would hang — so the survivors' world
+        re-forms over the devices they still own. A mesh that was
+        already local-only (the per-host data-parallel layout the
+        elastic chaos suite runs) survives unchanged, keeping its
+        compiled programs and in-flight state (returns False).
+        `force=True` rebuilds even a live local mesh."""
+        local_count = sum(1 for d in self.mesh.devices.flat
+                          if d.process_index == jax.process_index())
+        all_local = local_count == self.mesh.devices.size
+        if all_local and not force:
+            return False
+        from ..parallel.mesh import local_data_mesh
+        new_mesh = local_data_mesh()
+        shapes = jax.tree_util.tree_map(
+            lambda x: (jax.ShapeDtypeStruct(x.shape, x.dtype)
+                       if isinstance(x, jax.Array) else x), self.state)
+        self.mesh = new_mesh
+        self.state_specs = fsdp_sharding_tree(shapes, new_mesh)
+        self.state_shardings = sharding_tree(self.state_specs, new_mesh)
+        self._batch_axis = batch_spec(new_mesh)
+        if all_local:
+            # live state is fully addressable: move it onto the new
+            # mesh. (Post-shrink the old arrays reference dead devices
+            # and are NOT moved — the consensus-step restore that
+            # follows places fresh shards directly on the new mesh.)
+            self.state = jax.device_put(self.state, self.state_shardings)
+        self.best_state = None      # old-mesh arrays; re-seeded on restore
+        self._compile_programs()
+        _res_events.global_event_log().record(
+            "mesh_rebuilt", "elastic.world",
+            detail=f"1-D 'data' mesh over {new_mesh.devices.size} local "
+                   f"device(s); step programs re-jitted")
+        return True
+
+    def _elastic_restore(self, step: int) -> int:
+        """Restore exactly `step` with shards placed onto the CURRENT
+        mesh, independent of the live state's (possibly dead) old
+        shardings — the post-transition variant of
+        `restore_checkpoint`."""
+        def absify(x, s):
+            if isinstance(x, jax.Array) or hasattr(x, "shape"):
+                return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
+            return x
+        abstract = jax.tree_util.tree_map(absify, self.state,
+                                          self.state_shardings)
+        self.state, meta = self.checkpointer.restore(abstract, step=step)
+        best = float(meta.get("best_loss", float("inf")))
+        self.best_loss = best if best > 0 else float("inf")
+        if self.config.keep_best_state:
+            self.best_state = jax.tree_util.tree_map(jnp.copy, self.state)
+        return int(step)
 
     # -- flash autotuning ----------------------------------------------------
     def autotune_flash(self, global_batch: PyTree):
@@ -585,7 +664,9 @@ class DiffusionTrainer:
             data: Iterator[PyTree],
             total_steps: int,
             callbacks: Sequence[Callable[[int, float, Dict], None]] = (),
-            save_every: Optional[int] = None) -> Dict[str, Any]:
+            save_every: Optional[int] = None,
+            data_factory: Optional[Callable[[Any], Iterator[PyTree]]]
+            = None) -> Dict[str, Any]:
         """Run `total_steps` steps from `data` (host-local numpy batches).
 
         Returns summary metrics. The hot loop is sync-free pipelined:
@@ -601,6 +682,15 @@ class DiffusionTrainer:
         on streaming data (the background worker is joined before
         return, so handing `data` to another consumer afterwards is
         safe).
+
+        `data_factory(world_view) -> iterator` re-shards the input
+        pipeline around an elastic world transition (requires the
+        trainer's `elastic` manager): after a committed shrink /
+        re-admission / eviction the old upload worker is closed and a
+        fresh pipeline for the NEW (rank, size) starts. One
+        already-prefetched batch from the old shard may still be
+        consumed — an accepted off-by-one on streaming data, recorded
+        nowhere because it changes nothing the ledger cares about.
         """
         cfg = self.config
         losses, log_t0 = [], time.perf_counter()
@@ -632,12 +722,18 @@ class DiffusionTrainer:
                                    "mfu": [], "preempted": False,
                                    "watchdog_fired": False,
                                    "coordination_lost": False,
+                                   "elastic": [], "quorum_evicted": False,
                                    "saves": {"started": 0,
                                              "skipped_exists": 0,
                                              "failed": 0}}
         events = _res_events.global_event_log()
         fault_plan = _res_faults.active_plan()
         nan_pending = False     # step.nan fault armed for next loss read
+        elastic = self.elastic
+        # transition seconds spent INSIDE the checkpoint phase this step
+        # (commit-triggered shrink/admit): settle_step subtracts them so
+        # the time is attributed once, to its elastic bucket, not twice
+        elastic_spent = [0.0]
 
         # Telemetry: phase timing + goodput attribution always run (an
         # in-memory account on the default hub costs microseconds); the
@@ -695,25 +791,196 @@ class DiffusionTrainer:
             if res in history["saves"]:
                 history["saves"][res] += 1
 
+        def _adopt_change(change, bucket: str, restore_step, t0: float,
+                          in_ckpt_phase: bool) -> None:
+            """Common adoption of a committed WorldChange: re-arm the
+            coordinator in the new epoch namespace, rebuild the mesh if
+            it spanned lost devices, restore the consensus step when
+            the transition demands one, swap the data shard, and put
+            the transition on the books (goodput bucket + reclaimed
+            estimate, elastic/* metrics, JSONL row, history)."""
+            nonlocal upload
+            coord = (self.checkpointer.coordinator
+                     if self.checkpointer is not None else None)
+            if coord is not None:
+                coord.rebirth()
+            self._rebuild_world_mesh()
+            if restore_step is not None:
+                with tel.span("elastic.restore", cat="restore",
+                              args={"step": restore_step}):
+                    self._elastic_restore(restore_step)
+                # the restore rewound the step counter: unfetched loss
+                # slots no longer map to live steps
+                ring_pending[0] = 0
+                loss_window.clear()
+                inflight.clear()
+            if data_factory is not None and elastic is not None:
+                upload.close()
+                upload = prefetch_to_device(
+                    self.put_batch, data_factory(elastic.world_view()),
+                    depth=max(cfg.pipeline_depth, 1))
+            dt = time.perf_counter() - t0
+            goodput.record_badput(bucket, dt)
+            reclaimed = elastic.reclaimed_estimate(change.step, dt,
+                                                   goodput=goodput)
+            goodput.record_reclaimed(bucket, reclaimed)
+            if in_ckpt_phase:
+                elastic_spent[0] += dt
+            tel.counter("elastic/transitions").inc()
+            kind_counter = {"shrink": "elastic/shrinks",
+                            "grow": "elastic/readmits",
+                            "evict": "elastic/evictions"}.get(change.kind)
+            if kind_counter:
+                tel.counter(kind_counter).inc()
+            tel.gauge("elastic/world_size").set(float(change.world))
+            tel.gauge("elastic/epoch").set(float(change.epoch))
+            tel.gauge("elastic/last_transition_s").set(dt)
+            tel.write_record({
+                "type": "elastic_transition", "kind": change.kind,
+                "epoch": change.epoch, "world": change.world,
+                "members": list(change.members),
+                "removed": list(change.removed),
+                "added": list(change.added), "step": change.step,
+                "duration_s": round(dt, 6),
+                "reclaimed_s": round(reclaimed, 6),
+                "reason": change.reason})
+            history["elastic"].append({
+                "kind": change.kind, "epoch": change.epoch,
+                "world": change.world, "step": change.step,
+                "duration_s": dt, "reclaimed_s": reclaimed})
+
+        def _elastic_shrink(reason: str,
+                            in_ckpt_phase: bool = True) -> bool:
+            """Shrink-to-survive: returns True when a smaller world was
+            committed and adopted (training continues), False when the
+            caller must fall back to checkpoint-and-exit."""
+            from ..resilience.elastic import ElasticError
+            t0 = time.perf_counter()
+            try:
+                with tel.span("elastic.shrink", cat="elastic",
+                              args={"reason": reason}):
+                    change = elastic.shrink(reason)
+            except ElasticError as e:
+                events.record("elastic_error", "elastic.shrink",
+                              detail=repr(e))
+                return False
+            if change is None:
+                return False
+            _adopt_change(change, bucket="elastic_shrink",
+                          restore_step=change.step, t0=t0,
+                          in_ckpt_phase=in_ckpt_phase)
+            return True
+
+        def _elastic_boundary(committed_step) -> None:
+            """Healthy-commit-boundary hooks: the re-admission check.
+            KV traffic only — zero device syncs (the counting-mock
+            elasticity tests pin this)."""
+            from ..resilience.elastic import ElasticError
+            t0 = time.perf_counter()
+            try:
+                change = elastic.maybe_admit(current_step=committed_step)
+            except ElasticError as e:
+                # a member vanished between the commit ack and this
+                # round: same recovery as a commit timeout
+                events.record("elastic_error", "elastic.join",
+                              detail=repr(e))
+                if not _elastic_shrink(f"admission round failed: {e}"):
+                    history["coordination_lost"] = True
+                    stop["flag"] = True
+                return
+            if change is not None:
+                # members keep their live state (they ARE the consensus
+                # step); only the joiner restores
+                _adopt_change(change, bucket="elastic_readmit",
+                              restore_step=None, t0=t0,
+                              in_ckpt_phase=True)
+
+        def _elastic_quorum(hard: bool, step_no: int) -> None:
+            """Pod anomaly quorum at a numerics-cadence step: every
+            member votes; a sick-pod majority rolls everyone back to
+            the consensus step, an outlier minority is evicted."""
+            from ..resilience.elastic import ElasticError
+            t0 = time.perf_counter()
+            try:
+                decision = elastic.quorum_round(hard, step=step_no)
+            except ElasticError as e:
+                events.record("elastic_error", "elastic.quorum",
+                              detail=repr(e))
+                if not _elastic_shrink(f"quorum round failed: {e}",
+                                       in_ckpt_phase=False):
+                    history["coordination_lost"] = True
+                    stop["flag"] = True
+                return
+            if decision.kind == "none":
+                return
+            tel.write_record({
+                "type": "quorum_decision", "kind": decision.kind,
+                "step": step_no,
+                "votes": {str(k): v for k, v in decision.votes.items()}})
+            history.setdefault("quorum", []).append(decision.kind)
+            if decision.kind == "rollback_all":
+                if decision.step is not None:
+                    with tel.span("elastic.quorum_rollback", cat="restore",
+                                  args={"step": decision.step}):
+                        self._elastic_restore(decision.step)
+                else:
+                    # pod-sick with nothing committed: best-state path
+                    self._recover(float("nan"), step=step_no)
+                ring_pending[0] = 0
+                loss_window.clear()
+                inflight.clear()
+                dt = time.perf_counter() - t0
+                goodput.record_badput("quorum_rollback", dt)
+                goodput.record_reclaimed(
+                    "quorum_rollback",
+                    elastic.reclaimed_estimate(decision.step, dt,
+                                               goodput=goodput))
+                tel.counter("elastic/quorum_rollbacks").inc()
+            elif decision.kind == "evicted":
+                # this host's anomaly was the outlier: the survivors
+                # continue without it — leave WITHOUT committing (the
+                # final local save stays uncommitted, exactly like the
+                # coordination-lost exit)
+                history["quorum_evicted"] = True
+                coord = (self.checkpointer.coordinator
+                         if self.checkpointer is not None else None)
+                if coord is not None:
+                    coord.lost = True
+                stop["flag"] = True
+            elif decision.kind == "evict" and decision.change is not None:
+                _adopt_change(decision.change, bucket="quorum_rollback",
+                              restore_step=None, t0=t0,
+                              in_ckpt_phase=False)
+
         def commit_save(final: bool = False) -> None:
             """Two-phase-commit the save just dispatched (no-op without
             a ledger). A BarrierTimeout means a peer died mid-round:
-            mark coordination lost in the history and stop — the final
-            local save still happens, uncommitted, on the
-            checkpoint-and-exit path instead of hanging in collectives."""
+            with an elastic manager the survivors shrink the world and
+            KEEP TRAINING; otherwise (or when the shrink round itself
+            cannot complete) mark coordination lost in the history and
+            stop — the final local save still happens, uncommitted, on
+            the checkpoint-and-exit path instead of hanging in
+            collectives. A healthy commit boundary additionally runs
+            the re-admission check for parked replacement hosts."""
             if self.checkpointer is None:
                 return
             from ..resilience.coordination import BarrierTimeout
             try:
-                self.checkpointer.commit_pending()
+                committed = self.checkpointer.commit_pending()
             except BarrierTimeout:
+                if elastic is not None and not final \
+                        and _elastic_shrink("commit barrier timeout"):
+                    return
                 # the coordinator recorded barrier_timeout and marked
                 # itself lost; later commits degrade to local skips
                 history["coordination_lost"] = True
                 if not final:
                     stop["flag"] = True
+                return
+            if elastic is not None and not final and not stop["flag"]:
+                _elastic_boundary(committed)
 
-        def handle_numerics(step_no: int, aux, step_batch) -> None:
+        def handle_numerics(step_no: int, aux, step_batch) -> bool:
             """Cadence-step health handling: flatten the aux (the host
             readback), export gauges + the `numerics` JSONL row + HBM
             gauges, run the detector, and on the first HARD (non-finite)
@@ -721,7 +988,11 @@ class DiffusionTrainer:
             Soft z-score anomalies always only warn under `skip_step`
             (state is already donated); under `rollback` only hard
             anomalies roll back — a 6-sigma loss spike is evidence, a
-            NaN is proof."""
+            NaN is proof. Returns whether a hard anomaly was detected
+            (the elastic quorum's vote). With an elastic manager the
+            `rollback` action is NOT taken unilaterally: one host's
+            rollback would silently fork the fleet, so the verdict goes
+            to the pod quorum instead."""
             nonlocal provenance_done
             from ..telemetry.numerics import flatten_aux
             flat = flatten_aux(aux)
@@ -736,19 +1007,21 @@ class DiffusionTrainer:
                               step=step_no)
             anomalies = detector.observe_aux(step_no, flat)
             if not anomalies:
-                return
+                return False
             history["anomalies"] += len(anomalies)
             hard = [a for a in anomalies if a.hard]
             if hard and not provenance_done:
                 provenance_done = True
                 self._nan_provenance(step_batch, tel, step_no)
-            if hard and cfg.anomaly_action == "rollback":
+            if hard and cfg.anomaly_action == "rollback" \
+                    and elastic is None:
                 self._recover(flat.get("numerics/loss", float("nan")),
                               step=step_no)
                 # the restore rewound the step counter: unfetched ring
                 # slots no longer map to live steps — drop them (the
                 # rollback event records what happened to the window)
                 ring_pending[0] = 0
+            return bool(hard)
 
         # SIGTERM -> finish the current step, checkpoint, return. Only
         # the main thread may install handlers; elsewhere (e.g. fit
@@ -859,9 +1132,16 @@ class DiffusionTrainer:
                     steady_busies.append(busy)
             goodput.record_badput("data_stall", phases.get("data_wait", 0.0))
             goodput.record_badput("numerics", phases.get("numerics", 0.0))
+            # elastic transitions that ran inside this step's checkpoint
+            # phase were already attributed to their own bucket
+            # (elastic_shrink/elastic_readmit) — subtract them so each
+            # second lands in exactly one bucket
+            ckpt_s = max(phases.get("checkpoint", 0.0) - elastic_spent[0],
+                         0.0)
+            elastic_spent[0] = 0.0
             goodput.record_badput(
                 "coordination_lost" if history["coordination_lost"]
-                else "checkpoint_commit", phases.get("checkpoint", 0.0))
+                else "checkpoint_commit", ckpt_s)
             return phases
 
         def reclassify_warm_compile() -> None:
@@ -991,7 +1271,18 @@ class DiffusionTrainer:
                     # readback, gauges + JSONL row, detector verdicts,
                     # and (first hard anomaly only) provenance + action
                     with timer.phase("numerics"):
-                        handle_numerics(i + 1, pending_aux, current)
+                        hard_anomaly = handle_numerics(i + 1, pending_aux,
+                                                       current)
+                    if elastic is not None \
+                            and cfg.anomaly_action == "rollback":
+                        # the pod quorum rides the numerics cadence —
+                        # every member reaches this step in lockstep, so
+                        # the vote is collective by construction. KV
+                        # traffic only; its time lands in the `elastic`
+                        # phase, attributed to quorum_rollback when a
+                        # decision fires.
+                        with timer.phase("elastic"):
+                            _elastic_quorum(bool(hard_anomaly), i + 1)
                 steps_in_window += 1
 
                 recovered = False
@@ -1016,7 +1307,16 @@ class DiffusionTrainer:
                         window = loss_window
                         loss_window = []
                         vals = _fetch_losses([v for _, v in window])
-                    if nan_pending:
+                    if not vals:
+                        # an elastic transition (quorum rollback /
+                        # shrink restore) emptied the window mid-cadence:
+                        # every retained slot mapped to a rewound step.
+                        # Nothing to report; treat like a recovery so
+                        # the save guard below re-arms on fresh steps.
+                        steps_in_window = 0
+                        log_t0 = time.perf_counter()
+                        recovered = True
+                    if nan_pending and vals:
                         vals[-1], nan_pending = float("nan"), False
                     if gate_prev is not None \
                             and self.state.gate_events is not None:
@@ -1068,8 +1368,11 @@ class DiffusionTrainer:
                     # ONE code path for fault-injected and real NaNs:
                     # the detector's hard triggers subsume the old
                     # `isfinite or <= floor` ad-hoc check
-                    loss = vals[-1]
-                    if detector.abnormal_loss(loss, step=i + 1) is not None:
+                    loss = vals[-1] if vals else float("nan")
+                    if recovered:
+                        pass    # transition emptied the window above
+                    elif detector.abnormal_loss(loss,
+                                                step=i + 1) is not None:
                         self._recover(loss, step=i + 1)
                         steps_in_window = 0
                         log_t0 = time.perf_counter()

@@ -187,6 +187,54 @@ def health_section(numerics: List[Dict], anomalies: List[Dict],
     lines.append("")
 
 
+def elasticity_section(transitions: List[Dict], quorum: List[Dict],
+                       goodput: Dict, lines: List[str]) -> None:
+    """Elastic-world report (docs/RESILIENCE.md "Elastic world"): the
+    world-size timeline from the run's transition records, per-
+    transition badput + the reclaimed-vs-counterfactual estimate, and
+    quorum decisions. Rendered only when the run was elastic."""
+    badput = {k: float(v) for k, v in dict(goodput.get("badput_s",
+                                                       {})).items()}
+    reclaimed = {k: float(v) for k, v in dict(goodput.get("reclaimed_s",
+                                                          {})).items()}
+    elastic_buckets = {k: v for k, v in badput.items()
+                       if k in ("elastic_shrink", "elastic_readmit",
+                                "quorum_rollback")}
+    if not transitions and not quorum and not elastic_buckets:
+        return
+    lines.append("== Elasticity ==")
+    if transitions:
+        lines.append(f"{'step':>6s} {'kind':<8s} {'world':>5s} "
+                     f"{'epoch':>5s} {'cost s':>8s} {'reclaimed s':>12s}"
+                     f"  members")
+        for t in transitions:
+            lines.append(
+                f"{str(t.get('step', '?')):>6s} "
+                f"{str(t.get('kind', '?')):<8s} "
+                f"{int(t.get('world', 0)):>5d} "
+                f"{int(t.get('epoch', 0)):>5d} "
+                f"{float(t.get('duration_s', 0.0)):>8.2f} "
+                f"{float(t.get('reclaimed_s', 0.0)):>12.2f}"
+                f"  {t.get('members')}")
+        worlds = [int(t.get("world", 0)) for t in transitions]
+        lines.append(f"world-size timeline: "
+                     + " -> ".join(str(w) for w in worlds)
+                     + f" (final epoch {int(transitions[-1].get('epoch', 0))})")
+    for k in sorted(elastic_buckets):
+        rec = reclaimed.get(k, 0.0)
+        lines.append(f"badput {k:<16s} {elastic_buckets[k]:10.2f} s"
+                     + (f"   reclaimed vs. restart counterfactual "
+                        f"{rec:10.2f} s" if rec else ""))
+    total_rec = sum(reclaimed.values())
+    if total_rec:
+        lines.append(f"total badput reclaimed: {total_rec:10.2f} s "
+                     f"(estimated checkpoint-and-exit cost avoided)")
+    for q in quorum[-5:]:
+        lines.append(f"quorum @ step {q.get('step', '?')}: "
+                     f"{q.get('kind', '?')} (votes {q.get('votes')})")
+    lines.append("")
+
+
 def serving_section(metrics: List[Dict], lines: List[str]) -> None:
     """SLO summary from the last snapshot's serving/* series
     (docs/SERVING.md): request accounting, latency decomposition,
@@ -288,6 +336,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     numerics = [r for r in records if r.get("type") == "numerics"]
     anomalies = [r for r in records if r.get("type") == "numerics_anomaly"]
     provenance = [r for r in records if r.get("type") == "nan_provenance"]
+    transitions = [r for r in records
+                   if r.get("type") == "elastic_transition"]
+    quorum = [r for r in records if r.get("type") == "quorum_decision"]
 
     goodput: Dict = {}
     gp_path = os.path.join(directory, "goodput.json")
@@ -303,6 +354,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             "badput_s": {k[len("goodput/badput/"):-2]: v
                          for k, v in last.items()
                          if k.startswith("goodput/badput/")},
+            "reclaimed_s": {k[len("goodput/reclaimed/"):-2]: v
+                            for k, v in last.items()
+                            if k.startswith("goodput/reclaimed/")},
         }
 
     if args.json:
@@ -319,13 +373,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                           "numerics_last": (numerics[-1] if numerics
                                             else None),
                           "anomalies": anomalies,
-                          "nan_provenance": provenance}}
+                          "nan_provenance": provenance},
+               "elasticity": {
+                   "transitions": transitions,
+                   "quorum_decisions": quorum,
+                   "world_timeline": [int(t.get("world", 0))
+                                      for t in transitions],
+                   "reclaimed_s": dict(goodput.get("reclaimed_s", {}))}}
         print(json.dumps(doc, indent=2))
         return 0
 
     lines: List[str] = [f"telemetry report: {jsonl}", ""]
     goodput_section(goodput, lines)
     phase_section(steps, lines)
+    elasticity_section(transitions, quorum, goodput, lines)
     health_section(numerics, anomalies, provenance, metrics, lines)
     pod_section(pods, lines)
     serving_section(metrics, lines)

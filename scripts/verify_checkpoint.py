@@ -73,6 +73,12 @@ def main(argv=None) -> int:
                 print(f"ledger: {len(ledger['committed_steps'])} committed "
                       f"step(s) {ledger['committed_steps']} "
                       f"({ledger['entries']} entries)")
+                for w in ledger.get("world_changes", []):
+                    print(f"world:  {w.get('change')} -> "
+                          f"{w.get('world')} host(s) "
+                          f"{w.get('members')} from step {w.get('step')} "
+                          f"(epoch {w.get('epoch')}; "
+                          f"{w.get('reason', '')})")
             else:
                 print("ledger: none (pre-coordination checkpoint dir)")
         for r in reports:

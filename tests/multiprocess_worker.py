@@ -108,6 +108,19 @@ def main():
     ckpt_dir = sys.argv[4]
 
     os.environ["JAX_PLATFORMS"] = "cpu"
+    if phase.startswith("elastic_"):
+        # elastic phases run WITHOUT jax.distributed: its coordinator
+        # dies with process 0 and its world is fixed at initialize(),
+        # which is exactly what an elastic world cannot assume. The
+        # world lives on a FileTransport over the shared directory;
+        # each host owns its local devices and its own checkpoint dir
+        # (one SHARED control ledger), the host-level data-parallel
+        # layout the elastic design is built around.
+        result = {}
+        run_elastic_phase(phase, proc_id, ckpt_dir, result)
+        print("RESULT " + json.dumps({"proc": proc_id, "phase": phase,
+                                      **result}), flush=True)
+        return
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -217,6 +230,221 @@ def run_coordinated_phase(phase, proc_id, ckpt_dir, result):
                       step_after=int(jax.device_get(trainer.state.step)))
     else:
         raise SystemExit(f"unknown coordinated phase {phase}")
+
+
+# -- elastic chaos phases -----------------------------------------------------
+# 2 real processes, NO jax.distributed: membership/commit coordination
+# rides a FileTransport in <ckpt_root>/kv, each host checkpoints to
+# <ckpt_root>/host<rank> with the shared control ledger at <ckpt_root>.
+#
+#   elastic_kill    rank 1 dies hard (os._exit) at step 4's log, BEFORE
+#                   its step-4 commit vote; rank 0's commit barrier
+#                   times out, it shrinks to a world of 1 (ledger
+#                   `world_changed`), restores the consensus step 2,
+#                   re-shards its data, and keeps training to step 8 —
+#                   no coordination_lost exit.
+#   elastic_join    rank 0 starts alone (world of 1); rank 1 is
+#                   launched late by the driver, parks via
+#                   request_join, is admitted at a commit boundary,
+#                   restores the consensus step from rank 0's shard
+#                   dir, and both then commit the SAME final step with
+#                   world 2 recorded in the ledger.
+#   elastic_quorum  both alive; rank 1's params are poisoned by the
+#                   numerics.nan chaos site — its hard anomaly becomes
+#                   a pod quorum vote at the numerics cadence, the 1/2
+#                   outlier is EVICTED (never a unilateral rollback),
+#                   and rank 0 continues in a world of 1.
+
+
+def _elastic_world(proc_id, ckpt_root, barrier_timeout, elastic_cfg=None,
+                   members=None):
+    from flaxdiff_tpu import resilience as R
+    from flaxdiff_tpu.trainer.checkpoints import Checkpointer
+    kv_dir = os.path.join(ckpt_root, "kv")
+    host_dir = os.path.join(ckpt_root, f"host{proc_id}")
+    transport = R.FileTransport(kv_dir, rank=proc_id, world=2)
+    cfg = elastic_cfg or R.ElasticConfig(shrink_window=4.0,
+                                         vote_timeout=60.0)
+    manager = R.ElasticWorldManager(transport,
+                                    ledger=R.StepLedger(ckpt_root),
+                                    config=cfg, members=members)
+    coordinator = R.RestartCoordinator(R.MemberTransport(manager),
+                                       barrier_timeout=barrier_timeout)
+    ck = Checkpointer(host_dir, max_to_keep=16, coordinator=coordinator,
+                      ledger_directory=ckpt_root)
+    manager.valid_steps = ck.locally_valid_steps
+    return manager, ck, transport
+
+
+def _elastic_trainer(ck, manager, **cfg_kw):
+    import jax.numpy as jnp
+    import optax
+
+    from flaxdiff_tpu.parallel import create_mesh
+    from flaxdiff_tpu.predictors import EpsilonPredictionTransform
+    from flaxdiff_tpu.schedulers import CosineNoiseSchedule
+    from flaxdiff_tpu.trainer import DiffusionTrainer, TrainerConfig
+    import flax.linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, t, cond=None):
+            h = nn.Conv(8, (3, 3))(x)
+            return nn.Conv(x.shape[-1], (3, 3))(nn.tanh(h))
+
+    model = Tiny()
+    return DiffusionTrainer(
+        apply_fn=lambda p, x, t, c: model.apply({"params": p}, x, t, None),
+        init_fn=lambda key: model.init(
+            key, jnp.zeros((1, 8, 8, 1)), jnp.zeros((1,)))["params"],
+        tx=optax.adam(1e-3),
+        schedule=CosineNoiseSchedule(timesteps=100),
+        transform=EpsilonPredictionTransform(),
+        mesh=create_mesh(axes={"data": -1}),
+        config=TrainerConfig(normalize=False, keep_best_state=False,
+                             checkpoint_on_sigterm=False, **cfg_kw),
+        checkpointer=ck, elastic=manager)
+
+
+def _shard_stream(rank, size, batch=8):
+    """Per-shard synthetic stream: the seed encodes (rank, size) so a
+    post-transition factory call observably re-shards."""
+    import numpy as np
+    rng = np.random.default_rng(1000 * size + rank)
+    while True:
+        yield {"sample": rng.normal(size=(batch, 8, 8, 1))
+               .astype(np.float32)}
+
+
+def run_elastic_phase(phase, proc_id, ckpt_root, result):
+    import jax  # noqa: F401 — force platform latch before flax
+
+    from flaxdiff_tpu import resilience as R
+
+    factory_calls = []
+
+    def make_factory(manager):
+        def factory(view):
+            factory_calls.append([view.rank, view.size])
+            return _shard_stream(view.rank, view.size)
+        return factory
+
+    if phase == "elastic_kill":
+        manager, ck, transport = _elastic_world(
+            proc_id, ckpt_root, barrier_timeout=12.0,
+            elastic_cfg=R.ElasticConfig(shrink_window=4.0,
+                                        vote_timeout=30.0))
+        trainer = _elastic_trainer(ck, manager, log_every=2)
+        # line both hosts up post-build so jit skew cannot eat the
+        # commit barrier budget
+        transport.barrier("elastic_kill.armed", 180.0)
+        callbacks = []
+        if proc_id == 1:
+            def die(step, loss, metrics):
+                if step >= 4:
+                    os._exit(17)    # hard crash: no cleanup, no vote
+            callbacks = [die]
+        hist = trainer.fit(_shard_stream(proc_id, 2), total_steps=8,
+                           save_every=2, callbacks=callbacks,
+                           data_factory=make_factory(manager))
+        ck.wait_until_finished()
+        import jax as _jax
+        result.update(
+            elastic=hist["elastic"],
+            coordination_lost=hist["coordination_lost"],
+            committed=manager.ledger.committed_steps(),
+            world_changes=manager.ledger.world_changes(),
+            commit_worlds={str(e["step"]): e["world"]
+                           for e in manager.ledger.entries()
+                           if e.get("kind") == "commit"},
+            factory_calls=factory_calls,
+            goodput_badput=hist["goodput"]["badput_s"],
+            state_step=int(_jax.device_get(trainer.state.step)))
+    elif phase == "elastic_join":
+        cfg = R.ElasticConfig(shrink_window=4.0, vote_timeout=150.0,
+                              admit_timeout=240.0)
+        if proc_id == 0:
+            manager, ck, transport = _elastic_world(
+                0, ckpt_root, barrier_timeout=150.0, elastic_cfg=cfg,
+                members=[0])
+            trainer = _elastic_trainer(ck, manager, log_every=4)
+            # the tiny model trains 16 steps in well under the late
+            # joiner's process-startup time: hold the incumbent until
+            # the join request is PARKED so the admission demonstrably
+            # happens at a mid-fit commit boundary, not never
+            assert transport.get_json("el/join/1", timeout=180.0) \
+                is not None, "late joiner never parked"
+            hist = trainer.fit(_shard_stream(0, 1), total_steps=16,
+                               save_every=2,
+                               data_factory=make_factory(manager))
+        else:
+            manager, ck, transport = _elastic_world(
+                1, ckpt_root, barrier_timeout=150.0, elastic_cfg=cfg,
+                members=[0])
+            # park FIRST: admission arrives at an incumbent commit
+            # boundary; only then is the (expensive) trainer built
+            change = manager.request_join(timeout=cfg.admit_timeout)
+            trainer = _elastic_trainer(ck, manager, log_every=4)
+            # restore the consensus step from the incumbent's shard dir
+            # (the stand-in for pulling the shared store's checkpoint)
+            from flaxdiff_tpu.trainer.checkpoints import (
+                Checkpointer, abstract_state_like)
+            reader = Checkpointer(os.path.join(ckpt_root, "host0"),
+                                  use_ledger=True,
+                                  ledger_directory=ckpt_root)
+            state, _meta = reader.restore(
+                abstract_state_like(trainer.state), step=change.step)
+            trainer.state = state
+            reader.close()
+            result["joined_at"] = change.step
+            result["join_world"] = change.world
+            hist = trainer.fit(_shard_stream(1, 2),
+                               total_steps=16 - int(change.step),
+                               save_every=2,
+                               data_factory=make_factory(manager))
+        ck.wait_until_finished()
+        import jax as _jax
+        result.update(
+            elastic=hist["elastic"],
+            coordination_lost=hist["coordination_lost"],
+            committed=manager.ledger.committed_steps(),
+            world_changes=manager.ledger.world_changes(),
+            commit_worlds={str(e["step"]): e["world"]
+                           for e in manager.ledger.entries()
+                           if e.get("kind") == "commit"},
+            factory_calls=factory_calls,
+            members=manager.members,
+            state_step=int(_jax.device_get(trainer.state.step)))
+    elif phase == "elastic_quorum":
+        manager, ck, transport = _elastic_world(
+            proc_id, ckpt_root, barrier_timeout=60.0,
+            elastic_cfg=R.ElasticConfig(shrink_window=4.0,
+                                        vote_timeout=90.0))
+        trainer = _elastic_trainer(ck, manager, log_every=4,
+                                   numerics_cadence=2,
+                                   anomaly_action="rollback")
+        if proc_id == 1:
+            # poison ONE host's params: the divergent-anomaly scenario
+            R.install_plan(R.FaultPlan(
+                [R.FaultSpec("numerics.nan", at=(3,), error="flag",
+                             times=1)]))
+        transport.barrier("elastic_quorum.armed", 180.0)
+        hist = trainer.fit(_shard_stream(proc_id, 2), total_steps=8,
+                           save_every=4,
+                           data_factory=make_factory(manager))
+        ck.wait_until_finished()
+        result.update(
+            elastic=hist["elastic"],
+            quorum=hist.get("quorum", []),
+            quorum_evicted=hist["quorum_evicted"],
+            coordination_lost=hist["coordination_lost"],
+            committed=manager.ledger.committed_steps(),
+            world_changes=manager.ledger.world_changes(),
+            quorum_entries=manager.ledger.quorum_decisions(),
+            members=manager.members,
+            factory_calls=factory_calls)
+    else:
+        raise SystemExit(f"unknown elastic phase {phase}")
 
 
 if __name__ == "__main__":

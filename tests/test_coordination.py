@@ -509,6 +509,32 @@ def test_divergent_epochs_abort_every_round(tmp_path):
     assert ev.count("commit_aborted", "ckpt.commit") == 2
 
 
+def test_same_incarnation_step_drift_rejected_as_stale(tmp_path):
+    """ISSUE 12 satellite: two drivers of the SAME incarnation drifted
+    apart by a save interval (asymmetric restore, replayed rank). The
+    per-step tag on commit votes turns what used to be an opaque
+    non-unanimous abort into a distinct, diagnosable `commit_stale`
+    rejection — and the step never becomes restorable."""
+    ev = R.EventLog("t")
+    c0, c1 = _coordinators(2, event_log=ev)
+    led = R.StepLedger(str(tmp_path))
+    got = _both(lambda: c0.commit(10, led),
+                lambda: c1.commit(20, led))
+    assert got == [None, None]
+    assert led.committed_steps() == []
+    stale = ev.events("commit_stale")
+    assert len(stale) == 2 and "drift" in stale[0].detail
+    assert ev.count("commit_aborted", "ckpt.commit") == 0
+    # the legacy failure mode — one host's SAVE failed (vote None) at
+    # the same step — still reads as the plain non-unanimous abort,
+    # never mislabeled as driver drift
+    got = _both(lambda: c0.commit(4, led),
+                lambda: c1.commit(None, led))
+    assert got == [None, None]
+    assert ev.count("commit_aborted", "ckpt.commit") == 2
+    assert ev.count("commit_stale", "ckpt.commit") == 2   # unchanged
+
+
 def test_untagged_payload_rejected(tmp_path):
     """A foreign writer (pre-epoch binary, corrupted payload) that
     gathers as a raw value — not a tagged dict — is treated exactly

@@ -1,0 +1,464 @@
+"""Elastic world membership (resilience/elastic.py) on CPU: membership
+rounds over in-memory/file transports, the member-scoped coordinator
+transport, quorum decision rules, the goodput reclaimed account, ledger
+round-trips through the verify CLI, and the fit-loop seam contract
+(elastic enabled adds ZERO host syncs on healthy steps).
+
+The real 2-process kill/join/evict scenarios live in
+tests/test_multiprocess_elastic.py (chaos marker); everything here is
+single-process so the protocol runs in tier-1.
+"""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from flaxdiff_tpu import resilience as R
+from flaxdiff_tpu.trainer.checkpoints import Checkpointer
+
+
+def _all(*fns):
+    """Run each fn on its own thread (one per simulated host); re-raise
+    the first failure; return results in fn order."""
+    out = [None] * len(fns)
+    errs = []
+
+    def run(i, fn):
+        try:
+            out[i] = fn()
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=run, args=(i, f))
+          for i, f in enumerate(fns[1:], 1)]
+    for t in ts:
+        t.start()
+    run(0, fns[0])
+    for t in ts:
+        t.join()
+    if errs:
+        raise errs[0]
+    return out
+
+
+def _managers(n, ledger=None, cfg=None, transports=None):
+    tps = transports or R.InMemoryTransport.make_world(n)
+    cfg = cfg or R.ElasticConfig(shrink_window=0.4, vote_timeout=5.0)
+    return [R.ElasticWorldManager(t, ledger=ledger, config=cfg)
+            for t in tps], tps
+
+
+# -- FileTransport ------------------------------------------------------------
+
+def test_file_transport_collectives_and_kv(tmp_path):
+    tps = [R.FileTransport(str(tmp_path), rank=i, world=2,
+                           poll_interval=0.01) for i in range(2)]
+    assert _all(lambda: tps[0].barrier("b", 5.0),
+                lambda: tps[1].barrier("b", 5.0)) == [None, None]
+    got = _all(lambda: tps[0].allgather_json("g", {"r": 0}, 5.0),
+               lambda: tps[1].allgather_json("g", {"r": 1}, 5.0))
+    assert got[0] == got[1] == [{"r": 0}, {"r": 1}]
+    bc = _all(lambda: tps[0].broadcast_json("bc", [1, 2], 5.0),
+              lambda: tps[1].broadcast_json("bc", None, 5.0))
+    assert bc == [[1, 2], [1, 2]]
+    # point primitives: a dead member is a bounded None, not a hang
+    tps[0].put_json("k", {"x": 1})
+    assert tps[1].get_json("k", timeout=2.0) == {"x": 1}
+    assert tps[1].get_json("missing", timeout=0.05) is None
+    tps[0].offer_json("o", 7)
+    assert tps[1].poll_json("o", 0, timeout=2.0) == 7
+    assert tps[1].poll_json("o", 1, timeout=0.05) is None
+
+
+def test_file_transport_barrier_times_out_on_dead_member(tmp_path):
+    t0 = R.FileTransport(str(tmp_path), rank=0, world=2,
+                         poll_interval=0.01)
+    with pytest.raises(R.BarrierTimeout):
+        t0.barrier("alone", 0.3)
+
+
+# -- membership rounds --------------------------------------------------------
+
+def test_shrink_commits_world_changed_and_picks_consensus_step(tmp_path):
+    led = R.StepLedger(str(tmp_path))
+    led.record_commit(2, world_size=3)
+    led.record_commit(4, world_size=3)
+    mgrs, _ = _managers(3, ledger=led)
+    # rank 2 is dead: never enters the round
+    c0, c1 = _all(lambda: mgrs[0].shrink("barrier timeout"),
+                  lambda: mgrs[1].shrink("barrier timeout"))
+    for c in (c0, c1):
+        assert c.kind == "shrink"
+        assert c.members == [0, 1] and c.removed == [2]
+        assert c.step == 4 and c.epoch == 1
+    assert mgrs[0].members == mgrs[1].members == [0, 1]
+    assert mgrs[0].world_epoch == 1
+    wc = led.world_changes()
+    assert len(wc) == 1
+    assert wc[0]["change"] == "shrink" and wc[0]["world"] == 2
+    assert wc[0]["members"] == [0, 1] and wc[0]["step"] == 4
+
+
+def test_shrink_with_everyone_present_is_abandoned():
+    mgrs, _ = _managers(2)
+    c0, c1 = _all(lambda: mgrs[0].shrink("spurious"),
+                  lambda: mgrs[1].shrink("spurious"))
+    assert c0 is None and c1 is None
+    assert mgrs[0].members == [0, 1] and mgrs[0].world_epoch == 0
+
+
+def test_shrink_respects_min_world():
+    cfg = R.ElasticConfig(shrink_window=0.3, vote_timeout=3.0,
+                          min_world=2)
+    mgrs, _ = _managers(2, cfg=cfg)
+    # rank 1 dead: only 1 survivor < min_world -> no transition
+    assert mgrs[0].shrink("peer lost") is None
+    assert mgrs[0].members == [0, 1]
+
+
+def test_request_join_and_maybe_admit_grow_the_world(tmp_path):
+    led = R.StepLedger(str(tmp_path))
+    tps = R.InMemoryTransport.make_world(2)
+    cfg = R.ElasticConfig(shrink_window=0.3, vote_timeout=5.0,
+                          admit_timeout=10.0)
+    incumbent = R.ElasticWorldManager(tps[0], ledger=led, config=cfg,
+                                      members=[0])
+    joiner = R.ElasticWorldManager(tps[1], ledger=led, config=cfg,
+                                   members=[0])
+    jr, admitted = _all(lambda: joiner.request_join(),
+                        lambda: incumbent.maybe_admit(current_step=6))
+    assert jr.kind == "grow" and jr.members == [0, 1] and jr.step == 6
+    assert admitted is not None and admitted.added == [1]
+    assert incumbent.members == joiner.members == [0, 1]
+    assert incumbent.world_epoch == joiner.world_epoch == 1
+    grow = led.world_changes()[-1]
+    assert grow["change"] == "grow" and grow["world"] == 2
+    # a boundary with no parked joiner is a cheap no-op on both members
+    none0, none1 = _all(lambda: incumbent.maybe_admit(current_step=8),
+                        lambda: joiner.maybe_admit(current_step=8))
+    assert none0 is None and none1 is None
+
+
+def test_quorum_minority_evicted_majority_rolls_back(tmp_path):
+    led = R.StepLedger(str(tmp_path))
+    led.record_commit(4, world_size=3)
+    mgrs, _ = _managers(3, ledger=led)
+    # 1/3 anomalous: the outlier is evicted, survivors untouched
+    q = _all(lambda: mgrs[0].quorum_round(False, step=6),
+             lambda: mgrs[1].quorum_round(True, step=6),
+             lambda: mgrs[2].quorum_round(False, step=6))
+    assert [d.kind for d in q] == ["evict", "evicted", "evict"]
+    assert q[0].change is not None and q[0].change.members == [0, 2]
+    assert mgrs[0].members == [0, 2] and mgrs[0].world_epoch == 1
+    assert led.quorum_decisions()[-1]["decision"] == "evict"
+    assert led.world_changes()[-1]["change"] == "evict"
+    # 2/2 anomalous: pod-sick majority -> rollback-all to consensus
+    q2 = _all(lambda: mgrs[0].quorum_round(True, step=8),
+              lambda: mgrs[2].quorum_round(True, step=8))
+    assert all(d.kind == "rollback_all" for d in q2)
+    assert q2[0].step == 4
+    assert led.quorum_decisions()[-1]["decision"] == "rollback_all"
+    # healthy round: nothing happens, no ledger traffic
+    n_entries = len(led.entries())
+    q3 = _all(lambda: mgrs[0].quorum_round(False),
+              lambda: mgrs[2].quorum_round(False))
+    assert all(d.kind == "none" for d in q3)
+    assert len(led.entries()) == n_entries
+
+
+def test_quorum_solo_world_is_its_own_quorum(tmp_path):
+    led = R.StepLedger(str(tmp_path))
+    led.record_commit(2, world_size=1)
+    mgr = R.ElasticWorldManager(R.InMemoryTransport.make_world(1)[0],
+                                ledger=led)
+    assert mgr.quorum_round(False).kind == "none"
+    d = mgr.quorum_round(True, step=3)
+    assert d.kind == "rollback_all" and d.step == 2
+
+
+# -- member-scoped coordinator transport --------------------------------------
+
+def test_member_transport_commit_round_survives_a_shrink(tmp_path):
+    """The two-phase commit keeps working across an elastic transition:
+    before the shrink, a world-of-3 commit needs all three votes; after
+    rank 2 dies and the survivors shrink, the SAME coordinators (reborn
+    into the new epoch namespace) commit as a world of 2 — and the
+    commit entry records the shrunken world size."""
+    led = R.StepLedger(str(tmp_path))
+    mgrs, _ = _managers(3, ledger=led)
+    coords = [R.RestartCoordinator(R.MemberTransport(m),
+                                   barrier_timeout=5.0) for m in mgrs]
+    got = _all(lambda: coords[0].commit(2, led),
+               lambda: coords[1].commit(2, led),
+               lambda: coords[2].commit(2, led))
+    assert got == [2, 2, 2]
+    assert [e["world"] for e in led.entries()
+            if e.get("kind") == "commit"] == [3]
+
+    # rank 2 dies; 0 and 1 shrink, then their coordinators are reborn
+    _all(lambda: mgrs[0].shrink("rank 2 lost"),
+         lambda: mgrs[1].shrink("rank 2 lost"))
+    for c in coords[:2]:
+        c.lost = True       # what a real barrier timeout would have set
+        c.rebirth()
+        assert not c.lost
+    got = _all(lambda: coords[0].commit(4, led),
+               lambda: coords[1].commit(4, led))
+    assert got == [4, 4]
+    worlds = [e["world"] for e in led.entries()
+              if e.get("kind") == "commit"]
+    assert worlds == [3, 2]
+    assert led.committed_steps() == [2, 4]
+
+
+def test_member_transport_rejects_non_member():
+    mgrs, _ = _managers(2)
+    _all(lambda: mgrs[0].quorum_round(False, step=1),
+         lambda: mgrs[1].quorum_round(True, step=1))   # 1/2 -> evict 1
+    evicted = R.MemberTransport(mgrs[1])
+    with pytest.raises(R.CoordinationError):
+        evicted.barrier("nope", 0.1)
+
+
+# -- goodput reclaimed account ------------------------------------------------
+
+def test_goodput_reclaimed_is_outside_the_closure_and_persists(tmp_path):
+    from flaxdiff_tpu.telemetry.goodput import GoodputLedger
+    path = str(tmp_path / "goodput.json")
+    g = GoodputLedger(path)
+    g.record_productive(10.0)
+    g.record_badput("elastic_shrink", 2.0)
+    g.record_reclaimed("elastic_shrink", 30.0)
+    t = g.totals()
+    # reclaimed seconds never happened: they must NOT enter the
+    # productive+badput=total closure
+    assert t["total_s"] == pytest.approx(12.0)
+    assert t["reclaimed_s"] == {"elastic_shrink": 30.0}
+    assert t["reclaimed_total_s"] == pytest.approx(30.0)
+    snap = g.snapshot()
+    assert snap["goodput/reclaimed_s"] == pytest.approx(30.0)
+    assert snap["goodput/reclaimed/elastic_shrink_s"] == pytest.approx(30.0)
+    g.persist()
+    # next incarnation resumes the reclaimed account too
+    g2 = GoodputLedger(path)
+    g2.record_reclaimed("quorum_rollback", 5.0)
+    t2 = g2.totals()
+    assert t2["reclaimed_s"]["elastic_shrink"] == pytest.approx(30.0)
+    assert t2["reclaimed_s"]["quorum_rollback"] == pytest.approx(5.0)
+    assert t2["incarnations"] == 2
+
+
+def test_reclaimed_estimate_uses_ledger_and_startup_badput(tmp_path):
+    from flaxdiff_tpu.telemetry.goodput import GoodputLedger
+    led = R.StepLedger(str(tmp_path))
+    led.record_commit(2, world_size=2)
+    mgr = R.ElasticWorldManager(
+        R.InMemoryTransport.make_world(1)[0], ledger=led,
+        config=R.ElasticConfig(restart_cost_estimate=7.0))
+    g = GoodputLedger()
+    g.record_badput("compile", 3.0)
+    g.record_badput("restart", 1.0)
+    est = mgr.reclaimed_estimate(2, transition_s=0.5, goodput=g)
+    # >= startup badput + configured relaunch cost - transition cost;
+    # the work-since-commit term only adds to it
+    assert est >= 3.0 + 1.0 + 7.0 - 0.5
+    # with no committed step the work-lost term drops out but the
+    # startup counterfactual stands
+    est2 = mgr.reclaimed_estimate(None, transition_s=0.5, goodput=g)
+    assert est2 == pytest.approx(3.0 + 1.0 + 7.0 - 0.5)
+
+
+# -- ledger round-trip through the verify CLI (satellite) ---------------------
+
+def test_world_changed_round_trips_through_verify_cli(tmp_path, capsys):
+    led = R.StepLedger(str(tmp_path))
+    led.record_commit(2, world_size=2)
+    led.record_world_changed("shrink", 1, [0], 2, reason="host 1 lost",
+                             extra={"removed": [1]})
+    led.record_quorum({"0": False, "1": True}, "evict", step=4)
+    (tmp_path / "2").mkdir()    # a (bogus) step dir so the CLI scans
+    from scripts.verify_checkpoint import main as verify_main
+    rc = verify_main([str(tmp_path), "--all-steps", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1      # the bogus step dir is (correctly) not intact
+    wc = out["ledger"]["world_changes"]
+    assert len(wc) == 1 and wc[0]["change"] == "shrink"
+    assert wc[0]["members"] == [0] and wc[0]["step"] == 2
+    qd = out["ledger"]["quorum_decisions"]
+    assert len(qd) == 1 and qd[0]["decision"] == "evict"
+    assert qd[0]["votes"] == {"0": False, "1": True}
+
+
+def test_diagnose_run_renders_elasticity_section(tmp_path, capsys):
+    """ISSUE 12 satellite: diagnose_run gains an Elasticity section —
+    world-size timeline, per-transition cost + reclaimed estimate, and
+    quorum decisions — in text and --json."""
+    tel = tmp_path / "tel"
+    tel.mkdir()
+    rows = [
+        {"type": "step_phases", "step": 1, "host": 0.1, "wall": 0.2},
+        {"type": "elastic_transition", "kind": "shrink", "epoch": 1,
+         "world": 1, "members": [0], "removed": [1], "added": [],
+         "step": 2, "duration_s": 3.5, "reclaimed_s": 41.0,
+         "reason": "commit barrier timeout"},
+        {"type": "quorum_decision", "kind": "evict", "step": 6,
+         "votes": {"0": False, "1": True}},
+    ]
+    with open(tel / "telemetry.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    with open(tel / "goodput.json", "w") as f:
+        json.dump({"incarnations": 1, "productive_s": 100.0,
+                   "badput_s": {"elastic_shrink": 3.5},
+                   "reclaimed_s": {"elastic_shrink": 41.0}}, f)
+    from scripts.diagnose_run import main as diagnose_main
+    assert diagnose_main([str(tel)]) == 0
+    out = capsys.readouterr().out
+    assert "== Elasticity ==" in out
+    assert "shrink" in out and "world-size timeline: 1" in out
+    assert "elastic_shrink" in out and "41.00" in out
+    assert "quorum @ step 6: evict" in out
+    # --json carries the structured report
+    assert diagnose_main([str(tel), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["elasticity"]["world_timeline"] == [1]
+    assert doc["elasticity"]["transitions"][0]["reclaimed_s"] == 41.0
+    assert doc["elasticity"]["quorum_decisions"][0]["kind"] == "evict"
+    assert doc["elasticity"]["reclaimed_s"] == {"elastic_shrink": 41.0}
+
+
+# -- fit-loop integration -----------------------------------------------------
+
+def _tiny_trainer(mesh, ckpt=None, elastic=None, **cfg_kw):
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import optax
+
+    from flaxdiff_tpu.predictors import EpsilonPredictionTransform
+    from flaxdiff_tpu.schedulers import CosineNoiseSchedule
+    from flaxdiff_tpu.trainer import DiffusionTrainer, TrainerConfig
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, t, cond=None):
+            h = nn.Conv(8, (3, 3))(x)
+            return nn.Conv(x.shape[-1], (3, 3))(jnp.tanh(h))
+
+    model = Tiny()
+    return DiffusionTrainer(
+        apply_fn=lambda p, x, t, c: model.apply({"params": p}, x, t, None),
+        init_fn=lambda key: model.init(
+            key, jnp.zeros((1, 8, 8, 1)), jnp.zeros((1,)))["params"],
+        tx=optax.adam(1e-3),
+        schedule=CosineNoiseSchedule(timesteps=100),
+        transform=EpsilonPredictionTransform(), mesh=mesh,
+        config=TrainerConfig(normalize=False, **cfg_kw),
+        checkpointer=ckpt, elastic=elastic)
+
+
+def _data(rng, batch=8):
+    while True:
+        yield {"sample": rng.normal(size=(batch, 8, 8, 1))
+               .astype(np.float32)}
+
+
+def _solo_elastic_world(tmp_path):
+    transport = R.InMemoryTransport.make_world(1)[0]
+    mgr = R.ElasticWorldManager(
+        transport, config=R.ElasticConfig(shrink_window=0.2,
+                                          vote_timeout=2.0))
+    coord = R.RestartCoordinator(R.MemberTransport(mgr),
+                                 barrier_timeout=2.0)
+    ck = Checkpointer(str(tmp_path), coordinator=coord)
+    mgr.ledger = ck.ledger
+    mgr.valid_steps = ck.locally_valid_steps
+    return mgr, ck
+
+
+def test_elastic_healthy_fit_adds_zero_host_syncs(mesh, tmp_path,
+                                                  monkeypatch, rng):
+    """ISSUE 12 satellite: the shrink/re-admit machinery is KV-side
+    only — a healthy elastic fit performs EXACTLY the same seam-counted
+    host syncs as the identical non-elastic fit, and commits into the
+    ledger the same way."""
+    from flaxdiff_tpu.trainer import trainer as trainer_mod
+
+    class Counting:
+        def __init__(self, real):
+            self.real, self.calls = real, 0
+
+        def __call__(self, *a, **k):
+            self.calls += 1
+            return self.real(*a, **k)
+
+    counts = {}
+    for run in ("plain", "elastic"):
+        block = Counting(trainer_mod._block_until_ready)
+        fetch = Counting(trainer_mod._fetch_losses)
+        monkeypatch.setattr(trainer_mod, "_block_until_ready", block)
+        monkeypatch.setattr(trainer_mod, "_fetch_losses", fetch)
+        # depth > total_steps: the bounded-dispatch pop never triggers,
+        # so the block count cannot drift with scheduler noise between
+        # the two runs (the test_pipeline_loop isolation trick)
+        if run == "elastic":
+            mgr, ck = _solo_elastic_world(tmp_path / run)
+            tr = _tiny_trainer(mesh, ckpt=ck, elastic=mgr, log_every=2,
+                               keep_best_state=False, pipeline_depth=16)
+        else:
+            ck = Checkpointer(str(tmp_path / run), use_ledger=True)
+            tr = _tiny_trainer(mesh, ckpt=ck, log_every=2,
+                               keep_best_state=False, pipeline_depth=16)
+        hist = tr.fit(_data(rng), total_steps=6, save_every=2)
+        ck.wait_until_finished()
+        counts[run] = (block.calls, fetch.calls)
+        assert np.isfinite(hist["final_loss"])
+        assert hist["coordination_lost"] is False
+        assert hist["elastic"] == []
+        assert ck.ledger.committed_steps() == [2, 4, 6]
+        ck.close()
+    assert counts["elastic"] == counts["plain"]
+
+
+def test_forced_mesh_rebuild_reshards_and_keeps_training(mesh, rng):
+    """The elastic mesh-rebuild path: a trainer on the 8-device
+    ("data", "fsdp") mesh re-forms onto the 1-D local 'data' mesh,
+    re-jits, and keeps training with the SAME state values."""
+    import jax
+    tr = _tiny_trainer(mesh, log_every=4, keep_best_state=False)
+    l0 = float(jax.device_get(tr.train_step(next(_data(rng)))))
+    assert np.isfinite(l0)
+    assert tr._rebuild_world_mesh(force=True) is True
+    assert tr.mesh.axis_names == ("data",)
+    assert tr.mesh.devices.size == len(jax.local_devices())
+    # the live state survived the re-shard and the new program runs
+    assert int(jax.device_get(tr.state.step)) == 1
+    l1 = float(jax.device_get(tr.train_step(next(_data(rng)))))
+    assert np.isfinite(l1)
+    # an already-local 1-D mesh is a no-op without force
+    assert tr._rebuild_world_mesh() is False
+
+
+def test_elastic_quorum_rollback_all_in_fit(tmp_path, rng):
+    """Solo-world pod quorum inside fit: a hard numerics anomaly under
+    anomaly_action='rollback' takes the QUORUM path (world of one = its
+    own quorum), restores the consensus committed step, and accounts
+    the transition in the quorum_rollback badput bucket."""
+    from flaxdiff_tpu.parallel import create_mesh
+    mgr, ck = _solo_elastic_world(tmp_path / "q")
+    plan = R.FaultPlan([R.FaultSpec("numerics.nan", at=(3,),
+                                    error="flag", times=1)])
+    ev = R.EventLog("elastic-test")
+    with R.use_event_log(ev), plan.installed():
+        tr = _tiny_trainer(create_mesh(axes={"data": -1}), ckpt=ck,
+                           elastic=mgr, log_every=4, keep_best_state=False,
+                           numerics_cadence=2, anomaly_action="rollback")
+        hist = tr.fit(_data(rng), total_steps=8, save_every=2)
+    ck.wait_until_finished()
+    assert hist.get("quorum") == ["rollback_all"]
+    assert ev.count("quorum_rollback", "elastic.quorum") == 1
+    assert hist["goodput"]["badput_s"].get("quorum_rollback", 0.0) > 0.0
+    # the ledger recorded the pod (of one)'s decision
+    assert ck.ledger.quorum_decisions()[-1]["decision"] == "rollback_all"
+    assert np.isfinite(hist["final_loss"])
+    ck.close()

@@ -200,6 +200,67 @@ def test_fault_plan_stall_sleeps():
     assert slept == [3.0]
 
 
+def test_fault_plan_per_key_schedules_per_url():
+    """ISSUE 12 satellite (RESILIENCE.md open item): url-keyed hit
+    counters — "THIS url fails on its first two attempts, then
+    succeeds", deterministic under interleaving with other URLs (which
+    a site-global `at` can never be: other fetches advance it)."""
+    plan = R.FaultPlan([R.FaultSpec("data.fetch", at=(1, 2),
+                                    per_key=True, match="flaky")])
+    with plan.installed(), R.use_event_log(R.EventLog("t")) as ev:
+        # interleaved healthy URLs never fire and never advance the
+        # flaky URL's schedule
+        assert R.fault_check("data.fetch", key="http://ok/1") is False
+        with pytest.raises(R.InjectedFault):
+            R.fault_check("data.fetch", key="http://flaky/img")
+        assert R.fault_check("data.fetch", key="http://ok/2") is False
+        with pytest.raises(R.InjectedFault):
+            R.fault_check("data.fetch", key="http://flaky/img")
+        # third attempt for the SAME url: succeeds
+        assert R.fault_check("data.fetch", key="http://flaky/img") is False
+        # a different url matching the substring has its own counter
+        with pytest.raises(R.InjectedFault):
+            R.fault_check("data.fetch", key="http://flaky/other")
+        # keyless occurrences never fire a per_key spec
+        assert R.fault_check("data.fetch") is False
+        events = ev.events("fault_injected")
+        assert all("key=" in e.detail for e in events)
+        assert plan.key_hits("data.fetch", "http://flaky/img") == 3
+
+
+def test_per_key_spec_json_roundtrip():
+    plan = R.FaultPlan([R.FaultSpec("data.fetch", at=(1, 2),
+                                    per_key=True, match="u7")], seed=3)
+    clone = R.FaultPlan.from_json(plan.to_json())
+    spec = clone._specs["data.fetch"][0]
+    assert spec.per_key is True and spec.match == "u7"
+    assert spec.at == (1, 2)
+
+
+def test_url_fetcher_passes_url_as_fault_key():
+    """The data.fetch site is polled with key=url, so a per_key plan
+    models exactly one bad record: two injected failures ride the
+    retry policy, the third attempt succeeds."""
+    from flaxdiff_tpu.data.online_loader import default_url_fetcher
+    plan = R.FaultPlan([R.FaultSpec("data.fetch", at=(1, 2),
+                                    per_key=True, match="bad")])
+
+    def opener(url, timeout=None):
+        import contextlib
+        import io
+        return contextlib.closing(io.BytesIO(url.encode()))
+
+    pol = R.RetryPolicy(max_attempts=3, base_delay=0.0, max_delay=0.0)
+    fetch = default_url_fetcher(policy=pol, opener=opener)
+    with plan.installed(), R.use_event_log(R.EventLog("t")) as ev:
+        assert fetch("http://good/a") == b"http://good/a"
+        # the bad record costs its two injected failures, then lands
+        assert fetch("http://bad/rec") == b"http://bad/rec"
+        assert ev.count("retry", "data.fetch") == 2
+        # the good record after it is untouched
+        assert fetch("http://good/b") == b"http://good/b"
+
+
 def test_fault_plan_json_roundtrip_and_env():
     plan = R.FaultPlan([R.FaultSpec("ckpt.save", at=(1, 3), times=2),
                         R.FaultSpec("data.fetch", prob=0.25)], seed=9)

@@ -207,6 +207,26 @@ def parse_args(argv=None):
                    help="seconds survivors wait at a commit/restore "
                         "barrier before declaring a peer dead and "
                         "taking the checkpoint-and-exit path")
+    p.add_argument("--elastic", default="off", choices=["on", "off"],
+                   help="elastic world (docs/RESILIENCE.md): survivors "
+                        "of a lost host SHRINK the world and keep "
+                        "training instead of exiting on "
+                        "coordination_lost; replacement hosts are "
+                        "re-admitted live at commit boundaries; hard "
+                        "numerics anomalies become pod quorum votes. "
+                        "Implies coordinated checkpointing.")
+    p.add_argument("--elastic_shrink_window", type=float, default=5.0,
+                   help="seconds survivors wait for each peer's "
+                        "presence answer in a shrink round before "
+                        "declaring it dead")
+    p.add_argument("--elastic_min_world", type=int, default=1,
+                   help="refuse to shrink below this many hosts "
+                        "(checkpoint-and-exit instead)")
+    p.add_argument("--elastic_restart_cost", type=float, default=0.0,
+                   help="estimated relaunch overhead (scheduler queue, "
+                        "container pull) in seconds — feeds only the "
+                        "badput-reclaimed estimate of elastic "
+                        "transitions")
     p.add_argument("--val_every", type=int, default=0,
                    help="0 disables in-loop validation")
     p.add_argument("--val_samples", type=int, default=8)
@@ -570,7 +590,9 @@ def main(argv=None):
     # in-memory world-of-one transport keeps single-host runs on the
     # identical code path (ledger included) without jax.distributed.
     coordinator = None
-    if args.coordinated_restart == "on" or (
+    elastic_manager = None
+    want_elastic = args.elastic == "on"
+    if want_elastic or args.coordinated_restart == "on" or (
             args.coordinated_restart == "auto"
             and jax.process_count() > 1):
         from flaxdiff_tpu.resilience.coordination import (
@@ -589,8 +611,26 @@ def main(argv=None):
             (telemetry.goodput.incarnation
              if telemetry is not None else 0),
             timeout=args.commit_barrier_timeout)
+        vote_transport = coord_transport
+        if want_elastic:
+            # Elastic world (docs/RESILIENCE.md "Elastic world"): the
+            # manager owns membership; the coordinator's rounds run
+            # over a MemberTransport so commits keep working unchanged
+            # across shrink/grow transitions (keys are epoch-scoped,
+            # ranks member-relative). The manager's ledger/validity
+            # inputs are bound to the checkpointer below.
+            from flaxdiff_tpu.resilience.elastic import (
+                ElasticConfig, ElasticWorldManager, MemberTransport)
+            elastic_manager = ElasticWorldManager(
+                coord_transport,
+                config=ElasticConfig(
+                    shrink_window=args.elastic_shrink_window,
+                    vote_timeout=args.commit_barrier_timeout,
+                    min_world=args.elastic_min_world,
+                    restart_cost_estimate=args.elastic_restart_cost))
+            vote_transport = MemberTransport(elastic_manager)
         coordinator = RestartCoordinator(
-            coord_transport,
+            vote_transport,
             barrier_timeout=args.commit_barrier_timeout,
             epoch=agreed)
         if telemetry is not None:
@@ -598,6 +638,9 @@ def main(argv=None):
             # a stale same-incarnation driver's rows stay attributable
             telemetry.set_epoch(agreed)
     ckpt = Checkpointer(args.checkpoint_dir, coordinator=coordinator)
+    if elastic_manager is not None:
+        elastic_manager.ledger = ckpt.ledger
+        elastic_manager.valid_steps = ckpt.locally_valid_steps
     trainer = DiffusionTrainer(
         apply_fn=apply_fn, init_fn=init_fn, tx=tx, schedule=schedule,
         transform=transform, mesh=mesh,
@@ -616,7 +659,8 @@ def main(argv=None):
                              gate_counter=args.gate_counter,
                              loss_ring=args.loss_ring),
         policy=policy, null_cond=null_cond, checkpointer=ckpt,
-        autoencoder=autoencoder, telemetry=telemetry)
+        autoencoder=autoencoder, telemetry=telemetry,
+        elastic=elastic_manager)
 
     if ckpt.latest_step() is not None:
         step = trainer.restore_checkpoint()
