@@ -2062,6 +2062,12 @@ def main():
     # p50 from the first request). Off by default: it re-compiles the
     # composed program family, ~1 extra cold pass of stage budget.
     ap.add_argument("--serve_prewarm", action="store_true")
+    # stamp the final result with a hardware/software fingerprint
+    # (platform, device kind, jax version) so scripts/compare_runs.py
+    # can refuse to diff evidence from different experiments — two
+    # BENCH files without matching fingerprints are not a regression,
+    # they are different hardware
+    ap.add_argument("--evidence", action="store_true")
     ap.add_argument("--stage", choices=sorted(STAGES))
     args = ap.parse_args()
 
@@ -2131,6 +2137,21 @@ def main():
     result["probe"] = {"ok": probe["ok"],
                        "attempts": len(probe["attempts"]),
                        "history": probe["attempts"]}
+    if args.evidence:
+        # package metadata only — the orchestrator must not import jax
+        # (stages run in subprocesses against the probed backend); the
+        # platform comes from the probe, versions from importlib
+        stamp = {"platform": platform}
+        try:
+            from importlib import metadata as _md
+            stamp["jax"] = _md.version("jax")
+            stamp["jaxlib"] = _md.version("jaxlib")
+        except Exception as e:  # noqa: BLE001 — stamp is best-effort
+            stamp["version_error"] = str(e)
+        import platform as _plat
+        stamp["python"] = _plat.python_version()
+        stamp["machine"] = _plat.machine()
+        result["evidence"] = stamp
     emit(result, partial=True)   # parseable evidence exists from here on
 
     if platform is None:

@@ -520,6 +520,21 @@ class DiffusionSampler:
             return x0
 
         compiled = jax.jit(program)
+        # Program-evidence plumb-through (telemetry/programs.py): when
+        # the active hub carries a registry, the first invocation of
+        # this solo program is timed and registered under its cache
+        # key, like every serving chunk program. Wrapped ONLY when a
+        # registry is active at BUILD time, so the default path — and
+        # the analysis suite's `make_jaxpr` over this return value —
+        # gets the raw jitted program, byte-for-byte unchanged.
+        from ..telemetry import global_telemetry
+        if getattr(global_telemetry(), "programs", None) is not None:
+            from ..telemetry.programs import register_on_first_call
+            compiled = register_on_first_call(
+                compiled, kind="solo",
+                key=("solo", type(self.sampler).__name__,
+                     self.timestep_spacing,
+                     self.guidance_scale) + cache_key)
         self._compiled[cache_key] = compiled
         return compiled
 

@@ -67,7 +67,7 @@ class RequestState:
                  "x", "rng", "state", "pairs", "terminal_t", "nfe",
                  "done", "cond", "uncond", "compile_ms", "rounds",
                  "first_dispatch_t", "plan", "flags", "taps", "codes",
-                 "ref")
+                 "ref", "trace")
 
     def __init__(self, req: SampleRequest, future: ServingFuture,
                  submit_t: float, admit_t: float, group: tuple,
@@ -102,6 +102,9 @@ class RequestState:
         self.taps = taps
         self.codes = codes
         self.ref = ref
+        # request-scoped trace accumulator (telemetry/reqtrace.py);
+        # None on the disabled hub — the scheduler attaches it
+        self.trace = None
 
     @property
     def remaining(self) -> int:
@@ -119,6 +122,13 @@ class SamplerProgramEngine:
             telemetry = global_telemetry()
         self.telemetry = telemetry
         self._programs: Dict[tuple, Any] = {}
+        # last dispatched round's provenance (program kind/key, bucket,
+        # live steps, cache-plan codes) — written by advance()/
+        # finalize() on the single dispatch thread, read by the
+        # scheduler's request tracer right after the call. Host-side
+        # dicts only; None until the first round.
+        self.last_round_info: Optional[Dict[str, Any]] = None
+        self.last_finalize_info: Optional[Dict[str, Any]] = None
 
     # -- keys -----------------------------------------------------------------
     def _plan_for(self, req: SampleRequest):
@@ -183,6 +193,22 @@ class SamplerProgramEngine:
     @property
     def program_cache_size(self) -> int:
         return len(self._programs)
+
+    def _register_evidence(self, kind: str, group: tuple, bucket: int,
+                           scan_steps: int, program, args: tuple,
+                           compile_s: float) -> None:
+        """Program evidence registry hook (telemetry/programs.py):
+        called ONLY on a cache miss, right after the compiling call, so
+        every program ever cached by this engine has a `programs.jsonl`
+        row under its exact dispatch key — compile ms measured the same
+        way `SampleResult.compile_ms` is. No registry on the hub (the
+        disabled default) -> no work at all."""
+        reg = getattr(self.telemetry, "programs", None)
+        if reg is None:
+            return
+        key = self._program_key(kind, group, bucket, scan_steps)
+        reg.record_jitted(kind, key, program, args,
+                          compile_ms=compile_s * 1e3)
 
     # -- request admission ----------------------------------------------------
     def _sampler_for(self, req: SampleRequest):
@@ -322,13 +348,15 @@ class SamplerProgramEngine:
 
         t0 = time.perf_counter()
         refs_n = None
+        sched_row = None        # cache-plan step codes this round ran
         if plan is None:
+            kind_used = "chunk"
             program, miss = self._get_program(
                 "chunk", group, bucket, round_steps,
                 lambda: ds.make_chunk_program(round_steps))
-            x_n, keys_n, state_n = program(
-                self._params_for(group), x, keys, pairs, n_act_a,
-                offsets_a, cond, uncond, state)
+            prog_args = (self._params_for(group), x, keys, pairs,
+                         n_act_a, offsets_a, cond, uncond, state)
+            x_n, keys_n, state_n = program(*prog_args)
             taps_n = None
         elif refs is not None:
             # composed (timestep x spatial) plan: round-level step
@@ -342,12 +370,15 @@ class SamplerProgramEngine:
                 for j in range(len(w)):
                     want[j] = max(want[j], int(w[j]))
             codes_a = jnp.asarray(want, jnp.int32)
+            kind_used = "chunk_spatial"
+            sched_row = [int(w) for w in want]
             program, miss = self._get_program(
                 "chunk_spatial", group, bucket, round_steps,
                 lambda: ds.make_spatial_chunk_program(round_steps))
-            x_n, keys_n, state_n, taps_n, refs_n = program(
-                self._params_for(group), x, keys, pairs, n_act_a,
-                offsets_a, cond, uncond, state, codes_a, taps, refs)
+            prog_args = (self._params_for(group), x, keys, pairs,
+                         n_act_a, offsets_a, cond, uncond, state,
+                         codes_a, taps, refs)
+            x_n, keys_n, state_n, taps_n, refs_n = program(*prog_args)
             self.telemetry.counter("serving/cache_rows").inc(len(rows))
             self.telemetry.counter(
                 "serving/spatial_rows").inc(len(rows))
@@ -374,12 +405,15 @@ class SamplerProgramEngine:
                 for j in range(len(w)):
                     want[j] = want[j] or bool(w[j])
             flags_a = jnp.asarray(want)
+            kind_used = "chunk_cached"
+            sched_row = [int(w) for w in want]
             program, miss = self._get_program(
                 "chunk_cached", group, bucket, round_steps,
                 lambda: ds.make_cached_chunk_program(round_steps))
-            x_n, keys_n, state_n, taps_n = program(
-                self._params_for(group), x, keys, pairs, n_act_a,
-                offsets_a, cond, uncond, state, flags_a, taps)
+            prog_args = (self._params_for(group), x, keys, pairs,
+                         n_act_a, offsets_a, cond, uncond, state,
+                         flags_a, taps)
+            x_n, keys_n, state_n, taps_n = program(*prog_args)
             self.telemetry.counter("serving/cache_rows").inc(len(rows))
             refresh = reused = 0
             for i, r in enumerate(rows):
@@ -391,6 +425,25 @@ class SamplerProgramEngine:
             self.telemetry.counter(
                 "serving/cache_reused_steps").inc(reused)
         compile_s = (time.perf_counter() - t0) if miss else 0.0
+        if miss:
+            # evidence registry (telemetry/programs.py): the program
+            # just paid its compile — register it under the exact
+            # dispatch key with measured compile ms. No-op without a
+            # registry (the disabled default hub), so the warm path and
+            # the zero-retrace acceptance see no change.
+            self._register_evidence(kind_used, group, bucket,
+                                    round_steps, program, prog_args,
+                                    compile_s)
+        self.last_round_info = {
+            "kind": kind_used,
+            "key": str(self._program_key(kind_used, group, bucket,
+                                         round_steps)),
+            "bucket": int(bucket), "rows": len(rows),
+            "steps": int(round_steps), "miss": bool(miss),
+            "n_act": [int(v) for v in n_act[:len(rows)]],
+        }
+        if sched_row is not None:
+            self.last_round_info["codes"] = sched_row
 
         finished: List[RequestState] = []
         for i, r in enumerate(rows):
@@ -424,8 +477,17 @@ class SamplerProgramEngine:
             "terminal", group, bucket, 0,
             lambda: ds.make_terminal_program())
         t0 = time.perf_counter()
-        x0 = program(self._params_for(group), x, t_term, cond, uncond)
+        prog_args = (self._params_for(group), x, t_term, cond, uncond)
+        x0 = program(*prog_args)
         compile_s = (time.perf_counter() - t0) if miss else 0.0
+        if miss:
+            self._register_evidence("terminal", group, bucket, 0,
+                                    program, prog_args, compile_s)
+        self.last_finalize_info = {
+            "kind": "terminal",
+            "key": str(self._program_key("terminal", group, bucket, 0)),
+            "bucket": int(bucket), "miss": bool(miss),
+        }
 
         x0 = x0[:len(rows)]
         if ds.autoencoder is not None:

@@ -34,6 +34,7 @@ import time
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
+from ..telemetry.reqtrace import RequestTracer
 from .engine import (DEFAULT_BATCH_BUCKETS, RequestState,
                      SamplerProgramEngine, bucket_up, nfe_bucket)
 from .request import (DeadlineExceeded, SampleRequest, SampleResult,
@@ -107,11 +108,17 @@ class ServingScheduler:
         self.engine = engine
         self.config = config or SchedulerConfig()
         self.telemetry = telemetry
+        # request-scoped tracing (telemetry/reqtrace.py): every call is
+        # a no-op on a hub without a trace recorder, and a traced run
+        # performs the IDENTICAL seam-counted host syncs as an untraced
+        # one (counting-mock tested) — tracing is host bookkeeping only
+        self.tracer = RequestTracer(telemetry)
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._queue: Deque[Tuple[SampleRequest, ServingFuture, float]] \
-            = deque()
+        # queue entries: (request, future, submit_time, trace-or-None)
+        self._queue: Deque[Tuple[SampleRequest, ServingFuture, float,
+                                 object]] = deque()
         self._active: Dict[tuple, List[RequestState]] = {}
         self._completions: Deque[Tuple[List[RequestState], object, float]] \
             = deque()
@@ -167,7 +174,7 @@ class ServingScheduler:
                 # nothing will ever drain an unstarted scheduler —
                 # resolve pending futures instead of leaving waiters
                 # hanging
-                for _, fut, _ in self._queue:
+                for _, fut, _, _ in self._queue:
                     fut.set_exception(SchedulerClosed("scheduler closed"))
                 self._queue.clear()
                 for rows in self._active.values():
@@ -195,12 +202,15 @@ class ServingScheduler:
                 fut.set_exception(SchedulerClosed("scheduler closed"))
                 return fut
             tel.counter("serving/requests_in").inc()
+            t_sub = _now()
+            tr = self.tracer.begin(req, t_sub)   # None on disabled hub
             if len(self._queue) >= self.config.max_queue:
                 tel.counter("serving/shed").inc()
+                self.tracer.shed(tr, "queue_full", _now())
                 fut.set_exception(DeadlineExceeded(
                     f"queue full ({self.config.max_queue})"))
                 return fut
-            self._queue.append((req, fut, _now()))
+            self._queue.append((req, fut, t_sub, tr))
             tel.gauge("serving/queue_depth").set(len(self._queue))
             self._cv.notify_all()
         return fut
@@ -213,13 +223,14 @@ class ServingScheduler:
             return
         now = _now()
         kept: Deque = deque()
-        for req, fut, t_sub in self._queue:
+        for req, fut, t_sub, tr in self._queue:
             if req.deadline_s is not None and now - t_sub > req.deadline_s:
                 self.telemetry.counter("serving/shed").inc()
+                self.tracer.shed(tr, "deadline", now)
                 fut.set_exception(DeadlineExceeded(
                     f"deadline {req.deadline_s}s passed while queued"))
             else:
-                kept.append((req, fut, t_sub))
+                kept.append((req, fut, t_sub, tr))
         self._queue = kept
         self.telemetry.gauge("serving/queue_depth").set(len(self._queue))
 
@@ -227,7 +238,7 @@ class ServingScheduler:
         """Least-recently-served group among those with work (active
         rows or queued requests), queue order breaking ties."""
         candidates: List[tuple] = list(self._active.keys())
-        for req, _, _ in self._queue:
+        for req, _, _, _ in self._queue:
             gk = self.engine.group_key(req)
             if gk not in candidates:
                 candidates.append(gk)
@@ -242,16 +253,19 @@ class ServingScheduler:
         prepare their device carries."""
         admitted: List[RequestState] = []
         kept: Deque = deque()
-        for req, fut, t_sub in self._queue:
+        for req, fut, t_sub, tr in self._queue:
             if len(admitted) < capacity \
                     and self.engine.group_key(req) == gk:
                 try:
-                    admitted.append(self.engine.prepare(
-                        req, fut, t_sub, now))
+                    st = self.engine.prepare(req, fut, t_sub, now)
+                    st.trace = tr
+                    admitted.append(st)
                 except Exception as e:  # bad request, not a loop error
+                    self.tracer.shed(
+                        tr, f"prepare_error:{type(e).__name__}", _now())
                     fut.set_exception(e)
             else:
-                kept.append((req, fut, t_sub))
+                kept.append((req, fut, t_sub, tr))
         self._queue = kept
         self.telemetry.gauge("serving/queue_depth").set(len(self._queue))
         return admitted
@@ -293,10 +307,22 @@ class ServingScheduler:
                     r.first_dispatch_t = t_disp
 
             finished, _ = self.engine.advance(rows, bucket, round_steps)
+            if self.tracer.enabled:
+                # host timestamps + host-side dicts only: tracing must
+                # not add a single device sync to the dispatch loop
+                self.tracer.round(
+                    rows, getattr(self.engine, "last_round_info", None),
+                    t_disp, _now(), self._round_no)
             live = [r for r in rows if r.remaining > 0]
             if finished:
+                t_fin = _now()
                 out, _ = self.engine.finalize(
                     finished, bucket_up(len(finished), cfg.batch_buckets))
+                if self.tracer.enabled:
+                    self.tracer.finalize(
+                        finished,
+                        getattr(self.engine, "last_finalize_info", None),
+                        t_fin, _now())
             with self._cv:
                 if live:
                     self._active.setdefault(gk, []).extend(live)
@@ -317,7 +343,7 @@ class ServingScheduler:
                     r.future.set_exception(
                         SchedulerClosed("scheduler closed"))
             self._active.clear()
-            for _, fut, _ in self._queue:
+            for _, fut, _, _ in self._queue:
                 fut.set_exception(SchedulerClosed("scheduler closed"))
             self._queue.clear()
 
@@ -349,6 +375,11 @@ class ServingScheduler:
                 hist("serving/compile_ms").observe(r.compile_ms)
                 hist("serving/device_ms").observe(device_ms)
                 tel.counter("serving/requests_ok").inc()
+                # the trace row carries the SAME decomposition the
+                # histograms above observed — per-request span sums
+                # reconcile with the aggregates by construction
+                self.tracer.complete(r, queue_ms, r.compile_ms,
+                                     device_ms, latency_ms, t_ready)
                 r.future.set_result(SampleResult(
                     samples=host[i], request=r.req, queue_ms=queue_ms,
                     compile_ms=r.compile_ms, device_ms=device_ms,

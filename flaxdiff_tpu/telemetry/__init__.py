@@ -25,6 +25,16 @@ the ROADMAP depends on — you cannot speed up what you cannot attribute:
   tracing     TraceRecorder: host-side spans (fit phases, checkpoint
               rounds, sampler loops, recovery paths) as Chrome
               trace-event JSON, loadable in Perfetto
+  reqtrace    RequestTracer: request-scoped serving traces — follow
+              one SampleRequest through admission, queue, every
+              micro-batch round (program key, bucket, step codes),
+              and completion; spans + request_trace JSONL rows with
+              zero added host syncs (counting-mock enforced)
+  programs    ProgramRegistry: per-compiled-program evidence rows in
+              programs.jsonl (cache key, compile ms, jaxpr FLOPs,
+              cost_analysis flops/bytes, HBM peak, hardware
+              fingerprint) — per-program roofline attribution and the
+              measured substrate scripts/compare_runs.py diffs
   numerics    training-health: in-graph NumericsConfig/numerics_aux
               (per-module grad/param norms, update ratios, non-finite
               counts inside the jitted step at a cadence) + host-side
@@ -87,6 +97,15 @@ from .numerics import (
     unwrap_module_tree,
 )
 from .phases import PHASES, StepPhaseTimer
+from .programs import (
+    PROGRAMS_FILENAME,
+    ProgramRegistry,
+    hardware_fingerprint,
+    read_registry,
+    register_on_first_call,
+    stable_json,
+)
+from .reqtrace import RequestTrace, RequestTracer
 from .tracing import TraceRecorder
 
 __all__ = [
@@ -126,4 +145,12 @@ __all__ = [
     "Anomaly",
     "ANOMALY_ACTIONS",
     "MemoryMonitor",
+    "ProgramRegistry",
+    "PROGRAMS_FILENAME",
+    "hardware_fingerprint",
+    "read_registry",
+    "register_on_first_call",
+    "stable_json",
+    "RequestTrace",
+    "RequestTracer",
 ]

@@ -36,6 +36,7 @@ from .goodput import GOODPUT_FILENAME, GoodputLedger
 from .metrics import (JsonlExporter, LoggerExporter, MetricsRegistry,
                       PrometheusTextfileExporter)
 from .phases import StepPhaseTimer
+from .programs import PROGRAMS_FILENAME, ProgramRegistry
 from .tracing import TraceRecorder
 
 TELEMETRY_JSONL = "telemetry.jsonl"
@@ -50,12 +51,17 @@ class Telemetry:
                  goodput: Optional[GoodputLedger] = None,
                  aggregator: Optional[CrossHostAggregator] = None,
                  enabled: Optional[bool] = None,
-                 epoch: Optional[int] = None):
+                 epoch: Optional[int] = None,
+                 programs: Optional[ProgramRegistry] = None):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.exporters = list(exporters)
         self.recorder = recorder
         self.goodput = goodput if goodput is not None else GoodputLedger()
         self.aggregator = aggregator
+        # program evidence registry (telemetry/programs.py): None on
+        # the disabled hub — compile sites check for it and skip
+        # registration entirely, so the default path sees zero change
+        self.programs = programs
         # every raw JSONL row is stamped with this epoch (the
         # pod-agreed job incarnation — see set_epoch); defaults to the
         # local goodput incarnation so even a solo host's rows are
@@ -92,14 +98,23 @@ class Telemetry:
             exporters.append(PrometheusTextfileExporter(prometheus_textfile))
         if logger is not None:
             exporters.append(LoggerExporter(logger))
+        registry = MetricsRegistry()
         return cls(
-            registry=MetricsRegistry(),
+            registry=registry,
             exporters=exporters,
-            recorder=TraceRecorder(_in_dir(TRACE_FILENAME), pid=pid),
+            # bounded-event drops surface as a counter, not only as the
+            # saved file's flaxdiff_dropped_events field — a trace that
+            # silently degraded must be visible in the metrics stream
+            recorder=TraceRecorder(
+                _in_dir(TRACE_FILENAME), pid=pid,
+                on_drop=lambda n: registry.counter(
+                    "telemetry/trace_dropped_events").inc(n)),
             goodput=GoodputLedger(os.path.join(directory, GOODPUT_FILENAME),
                                   process_index=pid),
             aggregator=(CrossHostAggregator(transport)
                         if transport is not None else None),
+            programs=ProgramRegistry(_in_dir(PROGRAMS_FILENAME),
+                                     registry=registry),
             enabled=True)
 
     # -- instruments ---------------------------------------------------------

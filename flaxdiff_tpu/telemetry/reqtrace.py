@@ -1,0 +1,190 @@
+"""Request-scoped tracing for the serving layer (docs/OBSERVABILITY.md).
+
+The serving histograms (`serving/{latency,queue,compile,device}_ms`)
+answer "how is the fleet doing" in aggregate; this module answers the
+attribution question they cannot: *follow one `SampleRequest`* from
+submit through admission, queue wait, every micro-batch round it rode
+(with the compiled program's cache key, batch bucket, live-step counts,
+and cache-plan step codes), terminal denoise, and completion — the
+decomposition a multi-level split across chips (FastUSP-style) needs
+before any cross-chip placement decision is measurable.
+
+Cost contract (enforced by a counting-mock test): tracing is HOST-side
+bookkeeping only. Every timestamp is `time.perf_counter()` taken on the
+dispatch/completion threads at points the scheduler already timestamps;
+no device value is read, and the blessed `_block_until_ready` /
+`_device_get` seams are called exactly as often as in an untraced run.
+On the disabled hub (`Telemetry.recorder is None`) every call is a
+cheap no-op returning None.
+
+Output, per traced request:
+
+- Chrome trace-event spans in the hub's `TraceRecorder` (`trace.json`,
+  Perfetto-loadable): a `req.queue` span (submit -> first dispatch) and
+  a `req.serve` span (first dispatch -> samples on host) on a per-trace
+  lane, plus shared `serve.round` / `serve.finalize` spans on the
+  dispatch lane carrying program key / bucket / rows / step codes.
+- One `request_trace` JSONL row in `telemetry.jsonl` with the same
+  latency decomposition the result future carries — the row's
+  `queue_ms + compile_ms + device_ms == latency_ms` identity is exact
+  by construction (all four derive from the same three timestamps), so
+  per-request rows reconcile with the aggregate histograms to within
+  timer resolution (tested).
+
+`scripts/diagnose_run.py` renders the stream as a "Request traces"
+section (per-span p50/p99 + slowest-trace drill-down).
+"""
+from __future__ import annotations
+
+import itertools
+import os
+from typing import Any, Dict, List, Optional
+
+# Chrome-trace lane ids: rounds/finalize on one fixed dispatch lane,
+# each request on its own small lane so Perfetto stacks them readably.
+DISPATCH_TID = 900_000
+_REQ_TID_BASE = 100_000
+_REQ_TID_SPAN = 100_000
+
+
+class RequestTrace:
+    """Host-side accumulator for one request's trace (cheap: a list of
+    dicts appended by the dispatch thread, emitted once at completion)."""
+
+    __slots__ = ("trace_id", "seq", "submit_s", "summary", "rounds",
+                 "outcome")
+
+    def __init__(self, trace_id: str, seq: int, submit_s: float,
+                 summary: Dict[str, Any]):
+        self.trace_id = trace_id
+        self.seq = seq
+        self.submit_s = submit_s
+        self.summary = summary
+        self.rounds: List[Dict[str, Any]] = []
+        self.outcome: Optional[str] = None
+
+    @property
+    def tid(self) -> int:
+        return _REQ_TID_BASE + (self.seq % _REQ_TID_SPAN)
+
+
+def _req_summary(req) -> Dict[str, Any]:
+    return {
+        "sampler": str(getattr(req, "sampler", "?")),
+        "nfe": int(getattr(req, "diffusion_steps", 0)),
+        "resolution": int(getattr(req, "resolution", 0)),
+        "num_samples": int(getattr(req, "num_samples", 0)),
+        "guidance": float(getattr(req, "guidance_scale", 0.0)),
+        "seed": int(getattr(req, "seed", 0)),
+    }
+
+
+class RequestTracer:
+    """Mints trace ids at submit and emits per-request spans + JSONL
+    rows through the telemetry hub. All methods no-op (and `begin`
+    returns None) when the hub has no trace recorder, so the scheduler
+    carries the tracer unconditionally."""
+
+    def __init__(self, telemetry):
+        self.telemetry = telemetry
+        self._seq = itertools.count()
+        self._pid = os.getpid()
+
+    @property
+    def enabled(self) -> bool:
+        return (self.telemetry is not None
+                and self.telemetry.recorder is not None)
+
+    # -- lifecycle ----------------------------------------------------------
+    def begin(self, req, submit_s: float) -> Optional[RequestTrace]:
+        """Mint a trace at submit time; None on a disabled hub."""
+        if not self.enabled:
+            return None
+        seq = next(self._seq)
+        tr = RequestTrace(f"req-{self._pid}-{seq}", seq, submit_s,
+                          _req_summary(req))
+        self.telemetry.recorder.instant_at(
+            "req.submit", submit_s, cat="serving",
+            args={"trace_id": tr.trace_id, **tr.summary}, tid=tr.tid)
+        return tr
+
+    def shed(self, tr: Optional[RequestTrace], reason: str,
+             at_s: float) -> None:
+        """A request dropped before compute (deadline, queue-full, bad
+        request): close its trace with the shed outcome so the timeline
+        shows WHERE admission lost it."""
+        if tr is None or not self.enabled:
+            return
+        tr.outcome = f"shed:{reason}"
+        rec = self.telemetry.recorder
+        rec.event_at("req.queue", tr.submit_s, at_s, cat="serving",
+                     args={"trace_id": tr.trace_id,
+                           "outcome": tr.outcome}, tid=tr.tid)
+        self.telemetry.write_record({
+            "type": "request_trace", "trace_id": tr.trace_id,
+            "outcome": tr.outcome,
+            "queue_ms": (at_s - tr.submit_s) * 1e3, **tr.summary})
+
+    # -- dispatch-side spans (dispatch thread; host timestamps only) --------
+    def round(self, rows, info: Optional[Dict[str, Any]], t0: float,
+              t1: float, round_no: int) -> None:
+        """One micro-batch round: ONE shared `serve.round` span on the
+        dispatch lane + a per-participating-request round record (the
+        same dict, it is immutable once emitted) for the drill-down."""
+        if not self.enabled:
+            return
+        detail: Dict[str, Any] = {"round": int(round_no),
+                                  "ms": round((t1 - t0) * 1e3, 3)}
+        if info:
+            detail.update(info)
+        self.telemetry.recorder.event_at(
+            "serve.round", t0, t1, cat="serving", args=detail,
+            tid=DISPATCH_TID)
+        for r in rows:
+            tr = getattr(r, "trace", None)
+            if tr is not None:
+                tr.rounds.append(detail)
+
+    def finalize(self, rows, info: Optional[Dict[str, Any]], t0: float,
+                 t1: float) -> None:
+        """Terminal denoise + decode of the rows that completed."""
+        if not self.enabled:
+            return
+        detail: Dict[str, Any] = {"ms": round((t1 - t0) * 1e3, 3),
+                                  "rows": len(rows)}
+        if info:
+            detail.update(info)
+        self.telemetry.recorder.event_at(
+            "serve.finalize", t0, t1, cat="serving", args=detail,
+            tid=DISPATCH_TID)
+
+    # -- completion (completion thread, after the blessed host sync) --------
+    def complete(self, state, queue_ms: float, compile_ms: float,
+                 device_ms: float, latency_ms: float,
+                 ready_s: float) -> None:
+        """Emit the request's spans and its `request_trace` JSONL row.
+        Called with the SAME decomposition the `SampleResult` carries,
+        so per-request rows sum exactly to what the serving histograms
+        observed."""
+        tr = getattr(state, "trace", None)
+        if tr is None or not self.enabled:
+            return
+        tr.outcome = "ok"
+        first_dispatch_s = tr.submit_s + queue_ms / 1e3
+        rec = self.telemetry.recorder
+        rec.event_at("req.queue", tr.submit_s, first_dispatch_s,
+                     cat="serving",
+                     args={"trace_id": tr.trace_id}, tid=tr.tid)
+        rec.event_at("req.serve", first_dispatch_s, ready_s,
+                     cat="serving",
+                     args={"trace_id": tr.trace_id,
+                           "compile_ms": round(compile_ms, 3),
+                           "device_ms": round(device_ms, 3),
+                           "rounds": int(state.rounds)}, tid=tr.tid)
+        self.telemetry.write_record({
+            "type": "request_trace", "trace_id": tr.trace_id,
+            "outcome": "ok",
+            "queue_ms": queue_ms, "compile_ms": compile_ms,
+            "device_ms": device_ms, "latency_ms": latency_ms,
+            "rounds": int(state.rounds),
+            "round_detail": list(tr.rounds), **tr.summary})

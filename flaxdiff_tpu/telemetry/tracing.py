@@ -27,10 +27,18 @@ from typing import Dict, Optional
 
 
 class TraceRecorder:
-    """Collects spans/instants; writes Chrome trace-event JSON."""
+    """Collects spans/instants; writes Chrome trace-event JSON.
+
+    `on_drop` (optional, `callable(n)`) is invoked OUTSIDE the recorder
+    lock each time events are dropped past the bound — the telemetry
+    hub wires it to the `telemetry/trace_dropped_events` counter so a
+    trace that silently degraded is visible in the metrics stream, not
+    only in the saved file's `flaxdiff_dropped_events` field.
+    """
 
     def __init__(self, path: str, pid: int = 0,
-                 max_events: int = 100_000, clock=time.perf_counter):
+                 max_events: int = 100_000, clock=time.perf_counter,
+                 on_drop=None):
         self.path = path
         self.pid = int(pid)
         self.max_events = max_events
@@ -41,16 +49,21 @@ class TraceRecorder:
             {"ph": "M", "name": "process_name", "pid": self.pid,
              "args": {"name": f"host {self.pid}"}}]
         self.dropped = 0
+        self._on_drop = on_drop
 
     def _now_us(self) -> float:
         return (self._clock() - self._t0) * 1e6
 
     def _emit(self, ev: Dict[str, object]) -> None:
+        dropped = False
         with self._lock:
             if len(self._events) >= self.max_events:
                 self.dropped += 1
-                return
-            self._events.append(ev)
+                dropped = True
+            else:
+                self._events.append(ev)
+        if dropped and self._on_drop is not None:
+            self._on_drop(1)
 
     @contextlib.contextmanager
     def span(self, name: str, cat: str = "run",
@@ -76,6 +89,39 @@ class TraceRecorder:
             if a:
                 ev["args"] = a
             self._emit(ev)
+
+    def event_at(self, name: str, start_s: float, end_s: float,
+                 cat: str = "run",
+                 args: Optional[Dict[str, object]] = None,
+                 tid: Optional[int] = None) -> None:
+        """Complete event from EXPLICIT timestamps already taken on this
+        recorder's clock (`time.perf_counter` by default). The serving
+        request tracer records host timestamps inline in the dispatch
+        and completion threads (zero device syncs) and emits the spans
+        after the fact — this is the emission path."""
+        ev: Dict[str, object] = {
+            "ph": "X", "name": name, "cat": cat, "pid": self.pid,
+            "tid": (int(tid) if tid is not None
+                    else threading.get_ident() % 1_000_000),
+            "ts": (start_s - self._t0) * 1e6,
+            "dur": max(0.0, end_s - start_s) * 1e6}
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+    def instant_at(self, name: str, at_s: float, cat: str = "event",
+                   args: Optional[Dict[str, object]] = None,
+                   tid: Optional[int] = None) -> None:
+        """Instant event at an explicit recorder-clock timestamp."""
+        ev: Dict[str, object] = {
+            "ph": "i", "s": "p", "name": name, "cat": cat,
+            "pid": self.pid,
+            "tid": (int(tid) if tid is not None
+                    else threading.get_ident() % 1_000_000),
+            "ts": (at_s - self._t0) * 1e6}
+        if args:
+            ev["args"] = args
+        self._emit(ev)
 
     def instant(self, name: str, cat: str = "event",
                 args: Optional[Dict[str, object]] = None) -> None:

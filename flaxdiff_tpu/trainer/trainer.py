@@ -502,6 +502,56 @@ class DiffusionTrainer:
         with use_mesh(self.mesh):
             return traced_model_flops(self._step, self.state, batch)
 
+    def _register_program_evidence(self, tel, global_batch,
+                                   registered: set,
+                                   compile_s, monitored_compiled: bool,
+                                   flops_cost) -> None:
+        """Program evidence registry hook (telemetry/programs.py): one
+        `programs.jsonl` row per compiled step program — the plain step
+        at the first log window, the monitored twin once it has
+        compiled. The jaxpr-FLOPs walk is tens of ms of host work and
+        runs once per program; `flops_cost` is the XLA cost-analysis
+        figure fit already computed when the backend has a peak (never
+        triggered here — an AOT recompile of the train step on XLA CPU
+        is the documented compile blowup)."""
+        reg = getattr(tel, "programs", None)
+        if reg is None:
+            return
+        from ..parallel.context import use_mesh
+        from ..profiling import jaxpr_flops
+        batch = self._numeric_subtree(global_batch)
+        sig = ",".join(
+            f"{jax.tree_util.keystr(p)}{tuple(x.shape)}"
+            for p, x in jax.tree_util.tree_flatten_with_path(batch)[0])
+        targets = [("train_step", self._step, compile_s)]
+        if monitored_compiled and self._step_monitored is not None:
+            targets.append(("train_step_monitored",
+                            self._step_monitored, None))
+        for kind, prog, comp_s in targets:
+            if kind in registered:
+                continue
+            registered.add(kind)
+            flops_jaxpr = None
+            try:
+                with use_mesh(self.mesh):
+                    closed = jax.make_jaxpr(prog)(self.state, batch)
+                flops_jaxpr = jaxpr_flops(closed.jaxpr)
+            except Exception as e:  # noqa: BLE001 — evidence is
+                # best-effort; a failed probe degrades the field only
+                import logging
+                logging.getLogger("flaxdiff_tpu.trainer").debug(
+                    "train-step jaxpr probe failed: %s", e)
+            from ..telemetry.memory import MemoryMonitor
+            hbm = MemoryMonitor().sample().get("memory/peak_bytes_in_use")
+            reg.record(
+                kind, key=f"{kind}:{sig}",
+                compile_ms=(comp_s * 1e3 if comp_s else None),
+                flops_jaxpr=flops_jaxpr,
+                flops_cost=(flops_cost if kind == "train_step"
+                            else None),
+                hbm_peak_bytes=hbm,
+                extra={"compile_source": "first_step_busy"})
+
     # -- checkpointing -------------------------------------------------------
     def save_checkpoint(self, force: bool = False) -> bool:
         """Sharded async save of the live state (+best_loss meta)."""
@@ -1096,6 +1146,7 @@ class DiffusionTrainer:
         # cheap step".
         compile_busies: list = []
         steady_busies: list = []
+        registered_programs: set = set()    # program-evidence dedupe
 
         def settle_step(idx: int, compile_step: bool = False
                         ) -> Dict[str, float]:
@@ -1390,6 +1441,17 @@ class DiffusionTrainer:
                             flops = self.step_flops(global_batch)
                         step_mfu = (mfu(flops, dt / steps_in_window, peak)
                                     if flops else None)
+                        if tel.programs is not None:
+                            # program evidence registry: one row per
+                            # compiled step program, at the first log
+                            # window (plus the monitored twin once it
+                            # has compiled) — per-program roofline
+                            # attribution beside the global mfu gauges
+                            self._register_program_evidence(
+                                tel, global_batch, registered_programs,
+                                (compile_busies[0] if compile_busies
+                                 else None),
+                                monitored_compiled, flops)
                         window_steps = steps_in_window
                         steps_in_window = 0
                         history["steps"].append(i + 1)

@@ -5,10 +5,15 @@ Ingests what the telemetry subsystem wrote during a run
 (docs/OBSERVABILITY.md):
 
     telemetry.jsonl   per-step `step_phases` rows, `metrics` snapshots,
-                      `pod_metrics` aggregates
+                      `pod_metrics` aggregates, per-request
+                      `request_trace` rows
     goodput.json      the cumulative productive/badput account
+    programs.jsonl    the program evidence registry (compile ms, FLOPs
+                      per compiled program)
     trace.json        Chrome trace-event spans (validated, not rendered
-                      — load it in https://ui.perfetto.dev)
+                      — load it in https://ui.perfetto.dev; bounded-
+                      event drops are reported here and counted at
+                      `telemetry/trace_dropped_events`)
 
 and prints the decomposition every perf investigation starts from:
 what fraction of wall-clock trained, where the badput went, which step
@@ -279,6 +284,80 @@ def serving_section(metrics: List[Dict], lines: List[str]) -> None:
     lines.append("")
 
 
+def reqtrace_section(traces: List[Dict], lines: List[str]) -> None:
+    """Request-level latency attribution (telemetry/reqtrace.py): the
+    per-span breakdown across every traced request, plus a drill-down
+    into the slowest trace — which round, which program, which cache
+    codes."""
+    ok = [t for t in traces if t.get("outcome", "ok") == "ok"]
+    shed = [t for t in traces if t.get("outcome", "ok") != "ok"]
+    if not ok and not shed:
+        return
+    lines.append(f"== Request traces ({len(ok)} completed, "
+                 f"{len(shed)} shed) ==")
+    if ok:
+        lines.append(f"{'span':<12s} {'mean ms':>10s} {'p50 ms':>10s} "
+                     f"{'p99 ms':>10s} {'max ms':>10s}")
+        for span in ("queue_ms", "compile_ms", "device_ms",
+                     "latency_ms"):
+            xs = [float(t.get(span, 0.0)) for t in ok]
+            lines.append(
+                f"{span[:-3]:<12s} {sum(xs) / len(xs):>10.2f} "
+                f"{_percentile(xs, 0.5):>10.2f} "
+                f"{_percentile(xs, 0.99):>10.2f} {max(xs):>10.2f}")
+        slow = max(ok, key=lambda t: float(t.get("latency_ms", 0.0)))
+        lines.append(
+            f"slowest: {slow.get('trace_id', '?')} "
+            f"({slow.get('sampler', '?')} nfe={slow.get('nfe', '?')} "
+            f"res={slow.get('resolution', '?')}) "
+            f"latency {float(slow.get('latency_ms', 0.0)):.2f} ms = "
+            f"queue {float(slow.get('queue_ms', 0.0)):.2f} + compile "
+            f"{float(slow.get('compile_ms', 0.0)):.2f} + device "
+            f"{float(slow.get('device_ms', 0.0)):.2f}")
+        for d in (slow.get("round_detail") or [])[:8]:
+            codes = d.get("codes")
+            lines.append(
+                f"    round {d.get('round', '?'):>4} "
+                f"{d.get('kind', '?'):<13s} bucket {d.get('bucket', '?')} "
+                f"rows {d.get('rows', '?')} {d.get('ms', '?')} ms"
+                + (" MISS" if d.get("miss") else "")
+                + (f" codes={codes}" if codes is not None else ""))
+    for t in shed[-3:]:
+        lines.append(f"shed: {t.get('trace_id', '?')} "
+                     f"{t.get('outcome', '?')} after "
+                     f"{float(t.get('queue_ms', 0.0)):.2f} ms queued")
+    lines.append("")
+
+
+def programs_section(programs: List[Dict], lines: List[str]) -> None:
+    """Program evidence registry (telemetry/programs.py): per-compiled-
+    program compile cost + FLOPs — the roofline attribution rows."""
+    if not programs:
+        return
+    fp = next((p.get("fingerprint") for p in programs
+               if isinstance(p.get("fingerprint"), dict)), {})
+    lines.append(f"== Programs ({len(programs)} registered, "
+                 f"{fp.get('platform', '?')}"
+                 + (f" {fp['device_kind']}" if fp.get("device_kind")
+                    else "") + ") ==")
+    lines.append(f"{'kind':<22s} {'compile ms':>11s} {'GFLOP jaxpr':>12s} "
+                 f"{'GFLOP cost':>11s} {'key':<s}")
+    for p in sorted(programs,
+                    key=lambda r: (str(r.get("kind")), str(r.get("key")))):
+        def gf(name, p=p):
+            v = p.get(name)
+            return f"{v / 1e9:.3f}" if isinstance(v, (int, float)) \
+                else "-"
+        cm = p.get("compile_ms")
+        key = str(p.get("key", ""))
+        lines.append(
+            f"{str(p.get('kind', '?')):<22s} "
+            f"{(f'{cm:.1f}' if isinstance(cm, (int, float)) else '-'):>11s} "
+            f"{gf('flops_jaxpr'):>12s} {gf('flops_cost'):>11s} "
+            f"{key[:60] + ('…' if len(key) > 60 else '')}")
+    lines.append("")
+
+
 def counters_section(metrics: List[Dict], lines: List[str]) -> None:
     if not metrics:
         return
@@ -303,9 +382,13 @@ def validate_trace(trace_path: str, lines: List[str]) -> bool:
             doc = json.load(f)
         events = doc.get("traceEvents", [])
         spans = [e for e in events if e.get("ph") == "X"]
+        dropped = int(doc.get("flaxdiff_dropped_events", 0))
         lines.append(f"trace: {trace_path} — valid JSON, "
                      f"{len(spans)} spans / {len(events)} events "
-                     f"(load in https://ui.perfetto.dev)")
+                     + (f", {dropped} DROPPED past the event bound "
+                        f"(also at telemetry/trace_dropped_events) "
+                        if dropped else "")
+                     + "(load in https://ui.perfetto.dev)")
         return True
     except (OSError, json.JSONDecodeError) as e:
         lines.append(f"trace: {trace_path} — UNREADABLE ({e})")
@@ -339,6 +422,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     transitions = [r for r in records
                    if r.get("type") == "elastic_transition"]
     quorum = [r for r in records if r.get("type") == "quorum_decision"]
+    reqtraces = [r for r in records if r.get("type") == "request_trace"]
+
+    programs: List[Dict] = []
+    prog_path = os.path.join(directory, "programs.jsonl")
+    if os.path.exists(prog_path):
+        programs = [r for r in read_jsonl(prog_path)
+                    if r.get("type") == "program"]
 
     goodput: Dict = {}
     gp_path = os.path.join(directory, "goodput.json")
@@ -380,6 +470,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                    "world_timeline": [int(t.get("world", 0))
                                       for t in transitions],
                    "reclaimed_s": dict(goodput.get("reclaimed_s", {}))}}
+        ok_traces = [t for t in reqtraces
+                     if t.get("outcome", "ok") == "ok"]
+        span_stats = {}
+        for span in ("queue_ms", "compile_ms", "device_ms",
+                     "latency_ms"):
+            xs = [float(t.get(span, 0.0)) for t in ok_traces]
+            if xs:
+                span_stats[span] = {"mean": sum(xs) / len(xs),
+                                    "p50": _percentile(xs, 0.5),
+                                    "p99": _percentile(xs, 0.99),
+                                    "max": max(xs)}
+        doc["request_traces"] = {
+            "completed": len(ok_traces),
+            "shed": len(reqtraces) - len(ok_traces),
+            "spans": span_stats,
+            "slowest": (max(ok_traces,
+                            key=lambda t: float(t.get("latency_ms",
+                                                      0.0)))
+                        if ok_traces else None)}
+        doc["programs"] = programs
         print(json.dumps(doc, indent=2))
         return 0
 
@@ -390,6 +500,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     health_section(numerics, anomalies, provenance, metrics, lines)
     pod_section(pods, lines)
     serving_section(metrics, lines)
+    reqtrace_section(reqtraces, lines)
+    programs_section(programs, lines)
     counters_section(metrics, lines)
     trace_path = os.path.join(directory, "trace.json")
     if os.path.exists(trace_path):

@@ -179,6 +179,102 @@ def test_lint_json_output_is_stable(capsys):
     json.loads(first)       # and it parses
 
 
+# -- evidence diff CLI (scripts/compare_runs.py; ISSUE 13) --------------------
+
+def _telemetry_fixture(tmp_path, name, latency_p50, compile_ms,
+                       platform="cpu"):
+    """A minimal telemetry dir: one metrics snapshot + a programs.jsonl
+    row, values parameterized so the pair can regress on demand."""
+    d = tmp_path / name
+    d.mkdir()
+    rows = [
+        {"type": "metrics", "serving/latency_ms/p50": latency_p50,
+         "serving/latency_ms/p99": latency_p50 * 3.0,
+         "serving/latency_ms/count": 8.0,
+         "goodput/fraction": 0.9},
+        {"type": "request_trace", "outcome": "ok", "trace_id": "r0",
+         "queue_ms": 1.0, "compile_ms": compile_ms, "device_ms": 4.0,
+         "latency_ms": 5.0 + compile_ms},
+    ]
+    with open(d / "telemetry.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    prog = {"type": "program", "kind": "chunk", "key": "('chunk', 2, 2)",
+            "compile_ms": compile_ms, "flops_jaxpr": 1e9,
+            "flops_cost": None, "bytes_cost": None,
+            "hbm_peak_bytes": None,
+            "fingerprint": {"platform": platform,
+                            "device_kind": platform, "jax": "0"}}
+    with open(d / "programs.jsonl", "w") as f:
+        f.write(json.dumps(prog) + "\n")
+    return str(d)
+
+
+def test_compare_runs_clean_pair_and_byte_stable_json(tmp_path, capsys):
+    """Contract: equal evidence compares clean (exit 0) and the --json
+    report is byte-identical across invocations."""
+    from scripts.compare_runs import main
+    a = _telemetry_fixture(tmp_path, "a", 10.0, 100.0)
+    b = _telemetry_fixture(tmp_path, "b", 10.5, 102.0)  # within 10%
+    assert main([a, b, "--json"]) == 0
+    first = capsys.readouterr().out
+    assert main([a, b, "--json"]) == 0
+    assert capsys.readouterr().out == first
+    doc = json.loads(first)
+    assert doc["ok"] is True and doc["fingerprint"]["match"] is True
+    assert doc["programs"]["compared"] == 1
+
+
+def test_compare_runs_regression_exit_code(tmp_path, capsys):
+    """A latency regression above threshold exits 1 and names the
+    metric; improvements never fail."""
+    from scripts.compare_runs import main
+    a = _telemetry_fixture(tmp_path, "base", 10.0, 100.0)
+    worse = _telemetry_fixture(tmp_path, "worse", 20.0, 250.0)
+    assert main([a, worse]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "serving/latency_ms/p50" in out
+    # same movement, generous per-stage thresholds -> clean
+    assert main([a, worse, "--threshold", "3.0"]) == 0
+    capsys.readouterr()
+    # improvement direction: candidate FASTER is never a regression
+    assert main([worse, a]) == 0
+
+
+def test_compare_runs_fingerprint_mismatch(tmp_path, capsys):
+    """Different hardware is a different experiment: exit 2, unless
+    explicitly overridden."""
+    from scripts.compare_runs import main
+    a = _telemetry_fixture(tmp_path, "cpu_run", 10.0, 100.0,
+                           platform="cpu")
+    b = _telemetry_fixture(tmp_path, "tpu_run", 10.0, 100.0,
+                           platform="TPU v4")
+    assert main([a, b]) == 2
+    capsys.readouterr()
+    assert main([a, b, "--allow-fingerprint-mismatch"]) == 0
+
+
+def test_compare_runs_bench_files(tmp_path, capsys):
+    """BENCH-file mode: per-stage numeric diff + the --evidence stamp
+    feeding the fingerprint check."""
+    from scripts.compare_runs import main
+    base = {"value": 100.0, "platform": "cpu",
+            "evidence": {"platform": "cpu", "jax": "0.4.37"},
+            "stages": {"serve": {"status": "ok",
+                                 "warm": {"latency_ms": {"p50": 6.0}}},
+                       "broken": {"status": "failed: x"}}}
+    cand = json.loads(json.dumps(base))
+    cand["stages"]["serve"]["warm"]["latency_ms"]["p50"] = 30.0
+    pa, pb = tmp_path / "A.json", tmp_path / "B.json"
+    pa.write_text(json.dumps(base))
+    pb.write_text(json.dumps(cand))
+    assert main([str(pa), str(pb)]) == 1
+    assert "serve" in capsys.readouterr().out
+    # per-stage override rescues a stage known to be noisy
+    assert main([str(pa), str(pb), "--stage-threshold",
+                 "serve=5.0"]) == 0
+
+
 def test_legacy_shims_still_gate(tmp_path, capsys):
     """The old standalone gates are thin shims over the unified rules:
     same flags, same verdicts."""

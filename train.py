@@ -112,11 +112,15 @@ def parse_args(argv=None):
                         "(docs/OBSERVABILITY.md): per-step phase timings "
                         "+ pod-aggregated metrics into telemetry.jsonl, "
                         "a cumulative goodput/badput account in "
-                        "goodput.json, and host-side spans in a "
-                        "Perfetto-loadable trace.json. Costs one device "
+                        "goodput.json, host-side spans in a "
+                        "Perfetto-loadable trace.json, and the program "
+                        "evidence registry in programs.jsonl (per "
+                        "compiled program: cache key, compile ms, "
+                        "FLOPs, hardware fingerprint). Costs one device "
                         "sync per SAMPLED step (exact device-phase "
                         "timing; --telemetry_sample_every thins it). "
-                        "Analyze with scripts/diagnose_run.py")
+                        "Analyze with scripts/diagnose_run.py; diff two "
+                        "runs with scripts/compare_runs.py")
     p.add_argument("--telemetry_sample_every", type=int, default=1,
                    help="with --telemetry_dir, close async dispatch for "
                         "exact device-phase timing only every N-th step "
