@@ -1,0 +1,50 @@
+"""Machine-editable budget tables for the graph-hygiene analyzer.
+
+Split out of framework.py so `python scripts/lint.py --tighten` can
+rewrite the numbers mechanically (the framework emits shrink/stale
+notes; tighten acts on every one of them in one command). framework.py
+re-exports these names, so `framework.ALLOWLIST` etc. keep working —
+the dicts here are THE live objects, not copies.
+
+Hand-edit only to RAISE a budget deliberately (a review event: say in
+the PR why the new debt is load-bearing); shrinking is what --tighten
+is for. Semantics live in framework.py (`apply_budgets`) and
+docs/ANALYSIS.md "Allowlist policy".
+"""
+from typing import Dict
+
+# Per-(rule, file) finding-count MAXIMA. Empty dict for a rule = zero
+# tolerance everywhere (the silent-except contract since PR 9). Graph
+# rules budget by pseudo-file "jaxpr:<program>".
+ALLOWLIST: Dict[str, Dict[str, int]] = {
+    "callback-leak": {},
+    "host-sync": {
+        "flaxdiff_tpu/serving/loadgen.py": 2,
+        "flaxdiff_tpu/trainer/autoencoder_trainer.py": 4,
+        "flaxdiff_tpu/trainer/logging.py": 2,
+        "flaxdiff_tpu/trainer/trainer.py": 4,
+        "flaxdiff_tpu/trainer/validation.py": 2,
+    },
+    "implicit-reshard": {},
+    "metric-name": {},
+    "pallas-lane-slice": {},
+    "partition-coverage": {},
+    "rng-key-reuse": {},
+    "silent-except": {},
+}
+
+# bf16 -> f32 upcast element budgets per traced program (see framework.py
+# for the audit doctrine); unpinned programs are report-only.
+UPCAST_BUDGET: Dict[str, int] = {
+    "train_step_bf16": 865,
+}
+
+# Static comm-model budgets: estimated per-device collective bytes per
+# execution of a traced program (analysis/shard_rules.py documents the
+# byte model); unpinned programs are report-only.
+COMM_BUDGET: Dict[str, int] = {
+    "meshed_pipeline": 416,
+    "meshed_ring_attention": 4096,
+    "meshed_ring_attention_grad": 12288,
+    "meshed_ulysses_attention": 1536,
+}

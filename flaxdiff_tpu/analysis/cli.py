@@ -5,6 +5,8 @@
     python -m flaxdiff_tpu.analysis         # same tool
     python scripts/lint.py --rules host-sync,silent-except --no-graph
     python scripts/lint.py --root some/tree --rules silent-except
+    python scripts/lint.py --tighten        # rewrite budgets.py down
+                                            # to the observed counts
 
 Exit code 0 = every rule within its allowlist budget; 1 = over-budget
 findings (printed to stderr). `--json` prints ONE json object to
@@ -14,6 +16,9 @@ diff the findings. `--root` scans a custom file/tree with EMPTY
 allowlists and rule dir-scoping dropped (fixture mode — the contract
 the old standalone scripts/check_*.py gates had); graph rules are
 skipped there because they audit traced programs, not files.
+`--tighten` (analysis/tighten.py) shrinks every slack budget in
+budgets.py to its observed count — acting on the report's shrink notes
+is one command, never a hand-edit.
 """
 from __future__ import annotations
 
@@ -47,6 +52,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "jax import)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalogue and exit")
+    ap.add_argument("--tighten", action="store_true",
+                    help="rewrite budgets.py: every slack budget "
+                         "shrinks to its observed count (only rules "
+                         "that ran are touched)")
+    ap.add_argument("--tighten-out", default=None, metavar="PATH",
+                    help="write the tightened budgets module here "
+                         "instead of flaxdiff_tpu/analysis/budgets.py")
     args = ap.parse_args(argv)
 
     from . import framework
@@ -55,21 +67,51 @@ def main(argv: Optional[List[str]] = None) -> int:
         from . import ast_rules  # noqa: F401 — registers
         if not args.no_graph:
             from . import graph_rules  # noqa: F401 — registers
+            from . import shard_rules  # noqa: F401 — registers
         for rid, rule in sorted(framework.all_rules().items()):
             print(f"{rid:20s} {rule.doc}  [{rule.docs}]")
         return 0
 
     if not args.no_graph and args.root is None:
         # the graph rules trace programs: never let lint grab a real
-        # accelerator. Harmless if a backend already initialized (the
-        # in-process tier-1 tests run under JAX_PLATFORMS=cpu anyway).
+        # accelerator, and force the virtual multi-device host platform
+        # the MESHED inventory needs. Both are harmless if a backend
+        # already initialized (the in-process tier-1 tests pin the same
+        # environment in conftest.py).
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
 
     rule_ids = ([r.strip() for r in args.rules.split(",") if r.strip()]
                 if args.rules else None)
     report = framework.run(rule_ids=rule_ids, root=args.root,
                            docs_path=args.docs,
                            with_graph=not args.no_graph)
+
+    if args.tighten:
+        from .tighten import render_budgets, tightened_budgets
+        new_allow, new_up, new_comm, changes = tightened_budgets(
+            report, framework.ALLOWLIST, framework.UPCAST_BUDGET,
+            framework.COMM_BUDGET)
+        out_path = args.tighten_out or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "budgets.py")
+        with open(out_path, "w", encoding="utf-8") as f:
+            f.write(render_budgets(new_allow, new_up, new_comm))
+        for line in changes:
+            print(f"tightened: {line}")
+        print(f"{'wrote' if changes else 'no slack; rewrote'} "
+              f"{out_path} ({len(changes)} budget(s) tightened)")
+        if not report.ok:
+            print("over-budget findings remain — tighten never raises "
+                  "a budget; fix or hand-edit deliberately:",
+                  file=sys.stderr)
+            for fnd in sorted(report.failures):
+                print(fnd.render(), file=sys.stderr)
+        return 0 if report.ok else 1
+
     if args.json:
         print(framework.stable_json(report))
     else:

@@ -11,19 +11,23 @@ share one registry, one allowlist, and one report:
                and check source-level conventions — host-sync hygiene,
                the never-lane-slice kernel convention, silent exception
                swallowing, metric-name drift.
-  graph rules  (graph_rules.py) trace the REAL hot programs on CPU via
-               `jax.make_jaxpr` (programs.py builds them) and walk the
-               jaxprs the way `profiling.jaxpr_flops` does — RNG-key
-               reuse, callback leaks, a budgeted bf16->f32 upcast
-               audit.
+  graph rules  (graph_rules.py + shard_rules.py) trace the REAL hot
+               programs on CPU via `jax.make_jaxpr` (programs.py builds
+               them, including the MESHED parallel programs over a
+               forced multi-device host platform) and walk the jaxprs
+               the way `profiling.jaxpr_flops` does — RNG-key reuse,
+               callback leaks, a budgeted bf16->f32 upcast audit, the
+               collective-traffic inventory, partition-rule coverage,
+               and the implicit-resharding detector.
 
-Allowlists live HERE, in one place: `ALLOWLIST[rule_id][relpath]` is a
-MAXIMUM number of findings a file may carry. Budgets are debt, not
-permission — when a fix drops a file below its budget the text report
-says so and the entry should be edited down (the same doctrine the
-standalone `scripts/check_bare_except.py` gate established; that
-script and `scripts/check_metric_names.py` are now thin shims over
-rules `silent-except` and `metric-name`).
+Allowlists live in ONE place — `budgets.py`, re-exported here:
+`ALLOWLIST[rule_id][relpath]` is a MAXIMUM number of findings a file
+may carry. Budgets are debt, not permission — when a fix drops a file
+below its budget the text report says so and `scripts/lint.py
+--tighten` rewrites the entry down (the same doctrine the standalone
+`scripts/check_bare_except.py` gate established; that script and
+`scripts/check_metric_names.py` are now thin shims over rules
+`silent-except` and `metric-name`).
 
 Entry points: `scripts/lint.py`, `python -m flaxdiff_tpu.analysis`
 (both -> cli.py), and tier-1 via `tests/test_tools.py`.
@@ -41,47 +45,31 @@ REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 
 # ---------------------------------------------------------------------------
-# Allowlists — the ONE place grandfathered findings live. Every entry is
-# debt: budgets are MAXIMA, lower actual counts pass and the report then
-# asks you to shrink the entry. `silent-except` was emptied in this PR
-# (the four historical sites now record an event or log); keep it empty.
+# Budgets — grandfathered findings and per-program numeric ceilings live
+# in budgets.py (machine-rewritten by `scripts/lint.py --tighten`);
+# re-exported here so framework.ALLOWLIST / framework.UPCAST_BUDGET stay
+# the live objects every caller mutates and reads. Every entry is debt:
+# budgets are MAXIMA, lower actual counts pass and the report then asks
+# you to tighten. `silent-except` was emptied in PR 9; keep it empty.
+#
+# UPCAST_BUDGET doctrine: the audit is a report, not a verdict — upcasts
+# are often correct (f32 loss reduction, f32 norm accumulation) but
+# their TOTAL is an HBM-traffic tax that should only ever change
+# deliberately. Budgets are elements per trace, calibrated against the
+# tiny representative programs in programs.py.
+#
+# COMM_BUDGET doctrine: estimated per-device collective bytes per
+# program execution (shard_rules.py documents the per-primitive byte
+# model). Growth = a new collective or a bigger payload on the ICI —
+# raise deliberately or fix the sharding.
 # ---------------------------------------------------------------------------
 
-ALLOWLIST: Dict[str, Dict[str, int]] = {
-    "silent-except": {},
-    "metric-name": {},
-    # Grandfathered host syncs on COLD paths (eval/logging/save/load and
-    # host-side result post-processing). Each is a candidate for routing
-    # through a seam; none sits in the pipelined hot loop.
-    "host-sync": {
-        "flaxdiff_tpu/trainer/autoencoder_trainer.py": 4,
-        "flaxdiff_tpu/trainer/trainer.py": 4,
-        "flaxdiff_tpu/trainer/validation.py": 2,
-        "flaxdiff_tpu/trainer/logging.py": 2,
-        "flaxdiff_tpu/serving/loadgen.py": 2,
-    },
-    "pallas-lane-slice": {},
-    "rng-key-reuse": {},
-    "callback-leak": {},
-}
+from .budgets import ALLOWLIST, COMM_BUDGET, UPCAST_BUDGET  # noqa: E402
 
-# bf16 -> f32 upcast element budgets per traced program (the audit is a
-# report, not a verdict: upcasts are often correct — f32 loss reduction,
-# f32 norm accumulation — but their TOTAL is an HBM-traffic tax that
-# should only ever change deliberately). Budgets are elements per trace,
-# calibrated against the tiny representative programs in programs.py;
-# exceeding one means the model/step code added upcast traffic.
-UPCAST_BUDGET: Dict[str, int] = {
-    # measured 865 elements / 7 casts on the representative tiny model
-    # (the f32 loss/target math around the bf16 network; recalibrated
-    # when the diffusion-cache `deep` conv joined the tiny backbone —
-    # was 281/5): headroom for trace-level drift, fails if step code
-    # starts upcasting activations
-    "train_step_bf16": 1280,
-}
-# default budget for programs not pinned above: effectively unlimited —
-# the stats still land in the JSON report for trend tracking
+# default budgets for programs not pinned in budgets.py: effectively
+# unlimited — stats still land in the JSON report for trend tracking
 UPCAST_DEFAULT_BUDGET = 1 << 62
+COMM_DEFAULT_BUDGET = 1 << 62
 
 
 # ---------------------------------------------------------------------------
@@ -320,16 +308,16 @@ def apply_budgets(findings: Sequence[Finding],
         elif len(hits) < budget:
             notes.append(
                 f"{file}: {len(hits)} `{rule}` finding(s), budget "
-                f"{budget} — shrink ALLOWLIST in "
-                f"flaxdiff_tpu/analysis/framework.py")
+                f"{budget} — shrink the ALLOWLIST entry "
+                f"(`scripts/lint.py --tighten`)")
     # budgets for files that no longer have ANY finding are pure slack
     for rule, files in sorted(allowlist.items()):
         for file, budget in sorted(files.items()):
             if budget > 0 and (rule, file) not in groups:
                 notes.append(
                     f"{file}: 0 `{rule}` finding(s), budget {budget} — "
-                    f"shrink ALLOWLIST in "
-                    f"flaxdiff_tpu/analysis/framework.py")
+                    f"shrink the ALLOWLIST entry "
+                    f"(`scripts/lint.py --tighten`)")
     return failures, notes
 
 
@@ -385,12 +373,13 @@ def run(rule_ids: Optional[Sequence[str]] = None,
     graph_sel: List[GraphRule] = []
     if with_graph and (root is None or programs is not None):
         from . import graph_rules as _graph_rules  # noqa: F401
+        from . import shard_rules as _shard_rules  # noqa: F401
         graph_sel = [r for rid, r in sorted(GRAPH_RULES.items())
                      if ids is None or rid in ids]
         if graph_sel:
             if programs is None:
-                from .programs import hot_programs
-                programs = hot_programs()
+                from .programs import hot_programs, meshed_programs
+                programs = list(hot_programs()) + list(meshed_programs())
             gfound, graph_stats = run_graph_rules(graph_sel, programs)
             findings = findings + gfound
 

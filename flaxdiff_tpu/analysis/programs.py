@@ -19,7 +19,8 @@ callback-leak findings on every program below.
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Tuple
+import math
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -265,6 +266,238 @@ def spatial_chunk_program_jaxpr(sampler_name: str = "ddim",
     refs = jnp.zeros((rows, 1, 8, 8, 8), jnp.float32)
     return jax.make_jaxpr(prog)(params, x, keys, pairs, n_act, offsets,
                                 None, None, state, codes, taps, refs)
+
+
+# ---------------------------------------------------------------------------
+# Meshed inventory: the REAL parallel programs, traced under forced
+# multi-device CPU meshes (the tests' conftest and the lint CLI both pin
+# `--xla_force_host_platform_device_count=8`). Still `jax.make_jaxpr`
+# only — shard_map puts its collectives IN the jaxpr, so nothing
+# compiles and the global-reduction XLA-CPU compile trap never applies.
+# Each program is wrapped in a TracedProgram carrying the mesh facts the
+# sharding rules (shard_rules.py) need: axis sizes for the byte model,
+# declared input specs for the reshard detector, and (for the train
+# step) the partition-coverage subject.
+# ---------------------------------------------------------------------------
+
+class TracedProgram:
+    """ClosedJaxpr + the mesh facts the sharding rules consume.
+
+    Quacks like a ClosedJaxpr for the single-program rules (`.jaxpr`);
+    `axis_sizes` maps mesh axis name -> size, `in_specs` optionally
+    declares the PartitionSpec each program invar was built for, and
+    `partition` optionally carries a `parallel.partition_coverage`
+    report (the partition-coverage rule's subject)."""
+
+    def __init__(self, closed, axis_sizes: Optional[Dict[str, int]] = None,
+                 in_specs: Optional[List] = None, partition=None):
+        self.closed = closed
+        self.axis_sizes = dict(axis_sizes or {})
+        self.in_specs = in_specs
+        self.partition = partition
+
+    @property
+    def jaxpr(self):
+        return self.closed.jaxpr
+
+
+def _mesh_for(axes: Dict[str, int]):
+    """A mesh over the first prod(axes) local devices, or None when the
+    host platform doesn't expose enough (the builders then skip — the
+    tier-1 conftest and the lint CLI force 8 virtual CPU devices, so in
+    gating runs nothing skips)."""
+    from ..parallel.mesh import create_mesh
+    need = math.prod(axes.values())
+    devs = jax.devices()
+    if len(devs) < need:
+        return None
+    return create_mesh(axes=axes, devices=devs[:need])
+
+
+def _seq_specs(mesh, n: int):
+    from ..parallel.ring_attention import seq_shard_spec
+    return [seq_shard_spec(mesh)] * n
+
+
+@functools.lru_cache(maxsize=None)
+def meshed_ring_attention_jaxpr(grad: bool = False):
+    """`ring_self_attention` (shard_map + ppermute K/V ring) on a
+    data x seq mesh; with `grad`, the custom-vjp backward ring (dK/dV
+    accumulators riding home) traced through jax.grad."""
+    from ..parallel.ring_attention import ring_self_attention
+    mesh = _mesh_for({"data": 2, "seq": 4})
+    if mesh is None:
+        return None
+    q = jnp.zeros((2, 16, 4, 8), jnp.float32)
+
+    def fwd(q, k, v):
+        return ring_self_attention(q, k, v, mesh)
+
+    if grad:
+        def loss(q, k, v):
+            return jnp.sum(fwd(q, k, v) ** 2)
+        closed = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(
+            q, q, q)
+    else:
+        closed = jax.make_jaxpr(fwd)(q, q, q)
+    return TracedProgram(closed, {"data": 2, "seq": 4},
+                         in_specs=_seq_specs(mesh, 3))
+
+
+@functools.lru_cache(maxsize=None)
+def meshed_ulysses_attention_jaxpr():
+    """`ulysses_self_attention` (2 all_to_all re-shards) on the same
+    data x seq mesh; heads (4) divide the seq axis."""
+    from ..parallel.ulysses import ulysses_self_attention
+    mesh = _mesh_for({"data": 2, "seq": 4})
+    if mesh is None:
+        return None
+    q = jnp.zeros((2, 16, 4, 8), jnp.float32)
+    closed = jax.make_jaxpr(
+        lambda q, k, v: ulysses_self_attention(q, k, v, mesh))(q, q, q)
+    return TracedProgram(closed, {"data": 2, "seq": 4},
+                         in_specs=_seq_specs(mesh, 3))
+
+
+@functools.lru_cache(maxsize=None)
+def meshed_pipeline_jaxpr():
+    """`pipeline_blocks` (GPipe ticks: ppermute activation march +
+    masked psum collection) over a data x pipe mesh."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.pipeline import pipeline_blocks, stack_block_params
+    mesh = _mesh_for({"data": 2, "pipe": 4})
+    if mesh is None:
+        return None
+    stacked = stack_block_params(
+        [{"w": jnp.zeros((8, 8), jnp.float32)} for _ in range(4)])
+    x = jnp.zeros((8, 8), jnp.float32)
+    cond = jnp.zeros((8, 4), jnp.float32)
+
+    def block_fn(p, h, c):
+        return jnp.tanh(h @ p["w"])
+
+    closed = jax.make_jaxpr(
+        lambda sp, x, c: pipeline_blocks(block_fn, sp, x, c, mesh,
+                                         axis="pipe"))(stacked, x, cond)
+    # x/cond are reshaped into microbatch layout before the shard_map
+    # boundary (reshape deliberately drops spec tracking), so only the
+    # stacked block params carry a declared input layout
+    return TracedProgram(closed, {"data": 2, "pipe": 4},
+                         in_specs=[P("pipe"), None, None])
+
+
+@functools.lru_cache(maxsize=None)
+def meshed_train_step_jaxpr():
+    """The REAL `make_train_step` around a tiny SimpleDiT on a
+    data x fsdp x tensor mesh. GSPMD inserts this program's collectives
+    at compile time (no shard_map), so its comm inventory is legally
+    zero — its subject is partition-rule COVERAGE: every leaf of the
+    real DiT param tree (to_q/to_k/to_v/to_out, mlp kernels, AdaLN
+    tables, norm scales) must be decided by TP inference, FSDP
+    inference, or the deliberate small-tensor replicate. min_size is
+    scaled down so the tiny trace exercises the same decision paths a
+    production-size tree takes."""
+    import optax
+
+    from ..models.dit import SimpleDiT
+    from ..parallel.partition import partition_coverage
+    from ..predictors import EpsilonPredictionTransform
+    from ..schedulers import CosineNoiseSchedule
+    from ..trainer.train_state import TrainState
+    from ..trainer.train_step import TrainStepConfig, make_train_step
+
+    mesh = _mesh_for({"data": 2, "fsdp": 2, "tensor": 2})
+    if mesh is None:
+        return None
+    model = SimpleDiT(patch_size=2, emb_features=32, num_layers=1,
+                      num_heads=2, output_channels=1, backend="xla")
+
+    def apply_fn(params, x, t, cond):
+        return model.apply({"params": params}, x, t, None)
+
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8, 8, 1)), jnp.zeros((1,)),
+                        None)["params"]
+    state = TrainState.create(apply_fn=apply_fn, params=params,
+                              tx=optax.adam(1e-3),
+                              rng=jax.random.PRNGKey(1))
+    batch = {"sample": jnp.zeros((2, 8, 8, 1), jnp.float32)}
+    step = make_train_step(apply_fn, CosineNoiseSchedule(timesteps=100),
+                           EpsilonPredictionTransform(),
+                           TrainStepConfig(normalize=False),
+                           gate_nonfinite=True)
+    closed = jax.make_jaxpr(step)(state, batch)
+    coverage = partition_coverage(params, mesh, min_size=2 ** 8)
+    return TracedProgram(closed,
+                         {"data": 2, "fsdp": 2, "tensor": 2},
+                         partition=coverage)
+
+
+@functools.lru_cache(maxsize=None)
+def meshed_chunk_program_jaxpr(sampler_name: str = "ddim",
+                               rows: int = 2, round_steps: int = 2):
+    """The serving chunk program with its request rows sharded over a
+    `data` engine group — the layout pod-scale serving (ROADMAP 1)
+    dispatches — via explicit row-axis constraints, so the reshard
+    detector sees the declared boundary layout."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.partition import with_named_constraint
+    mesh = _mesh_for({"data": 2})
+    if mesh is None:
+        return None
+    ds, params = _sampler_pieces(sampler_name)
+    prog = ds.make_chunk_program(round_steps)
+
+    def sharded_prog(params, x, keys, pairs, n_act, offsets, state):
+        x = with_named_constraint(x, P("data"), mesh)
+        keys = with_named_constraint(keys, P("data"), mesh)
+        return prog(params, x, keys, pairs, n_act, offsets, None, None,
+                    state)
+
+    x = jnp.zeros((rows, 1, 8, 8, 1), jnp.float32)
+    keys = jnp.stack([jax.random.PRNGKey(i) for i in range(rows)])
+    pairs = jnp.zeros((rows, round_steps, 2), jnp.float32)
+    n_act = jnp.zeros((rows,), jnp.int32)
+    offsets = jnp.zeros((rows,), jnp.int32)
+    row_states = [ds.sampler.init_state(
+        jnp.zeros((1, 8, 8, 1), jnp.float32)) for _ in range(rows)]
+    state = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                   *row_states)
+    closed = jax.make_jaxpr(sharded_prog)(params, x, keys, pairs,
+                                          n_act, offsets, state)
+    return TracedProgram(closed, {"data": 2})
+
+
+MESHED_PROGRAM_BUILDERS = {
+    "meshed_ring_attention": lambda: meshed_ring_attention_jaxpr(),
+    "meshed_ring_attention_grad":
+        lambda: meshed_ring_attention_jaxpr(grad=True),
+    "meshed_ulysses_attention":
+        lambda: meshed_ulysses_attention_jaxpr(),
+    "meshed_pipeline": lambda: meshed_pipeline_jaxpr(),
+    "meshed_train_step_fsdp": lambda: meshed_train_step_jaxpr(),
+    "meshed_chunk_ddim": lambda: meshed_chunk_program_jaxpr("ddim"),
+}
+
+
+def meshed_programs(names: Optional[List[str]] = None
+                    ) -> List[Tuple[str, TracedProgram]]:
+    """[(name, TracedProgram)] for the sharding rules. Programs whose
+    mesh cannot form on this host platform (too few devices — the CLI
+    and conftest force 8) are omitted rather than faked."""
+    sel = names if names is not None else sorted(MESHED_PROGRAM_BUILDERS)
+    unknown = [n for n in sel if n not in MESHED_PROGRAM_BUILDERS]
+    if unknown:
+        raise ValueError(f"unknown meshed program(s) {unknown}; known: "
+                         f"{sorted(MESHED_PROGRAM_BUILDERS)}")
+    out: List[Tuple[str, TracedProgram]] = []
+    for name in sel:
+        prog = MESHED_PROGRAM_BUILDERS[name]()
+        if prog is not None:
+            out.append((name, prog))
+    return out
 
 
 # the inventory the CLI and the tier-1 clean-pass tests iterate

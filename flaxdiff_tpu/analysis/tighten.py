@@ -1,0 +1,164 @@
+"""`scripts/lint.py --tighten`: act on every shrink note in one command.
+
+The framework has always SAID when a budget carried slack ("N findings,
+budget M — shrink"); acting on the notes was a hand-edit. This module
+computes the tightened budget tables from a finished Report and
+serializes a fresh `budgets.py`, so the whole loop is:
+
+    python scripts/lint.py --tighten        # rewrite budgets.py
+    python scripts/lint.py                  # re-lints clean, zero notes
+
+Semantics (deliberately one-directional):
+
+- ALLOWLIST entries are set to min(old budget, observed count) — tighten
+  never RAISES a budget (an over-budget run keeps failing; masking a
+  regression is a hand-edit and a review event) and never ADDS a file
+  that wasn't grandfathered. Entries that reach 0 are dropped.
+- UPCAST_BUDGET pins are set to the observed element count (exact: the
+  traces are deterministic, so drift only happens when code changes —
+  at which point the failure is the feature).
+- COMM_BUDGET pins are set to observed comm bytes, and every program
+  with nonzero collective traffic that wasn't pinned yet GAINS a pin —
+  pinning is tightening (it was unlimited before).
+
+Only rules that actually RAN in the report are touched: a scoped run
+(`--rules host-sync --tighten`) rewrites host-sync budgets and leaves
+everything else byte-identical.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from .framework import Report
+
+BUDGET_HEADER = '''\
+"""Machine-editable budget tables for the graph-hygiene analyzer.
+
+Split out of framework.py so `python scripts/lint.py --tighten` can
+rewrite the numbers mechanically (the framework emits shrink/stale
+notes; tighten acts on every one of them in one command). framework.py
+re-exports these names, so `framework.ALLOWLIST` etc. keep working —
+the dicts here are THE live objects, not copies.
+
+Hand-edit only to RAISE a budget deliberately (a review event: say in
+the PR why the new debt is load-bearing); shrinking is what --tighten
+is for. Semantics live in framework.py (`apply_budgets`) and
+docs/ANALYSIS.md "Allowlist policy".
+"""
+from typing import Dict
+'''
+
+
+def observed_counts(report: Report) -> Dict[Tuple[str, str], int]:
+    counts: Dict[Tuple[str, str], int] = {}
+    for f in report.findings:
+        counts[(f.rule, f.file)] = counts.get((f.rule, f.file), 0) + 1
+    return counts
+
+
+def tightened_budgets(report: Report,
+                      allowlist: Dict[str, Dict[str, int]],
+                      upcast: Dict[str, int],
+                      comm: Dict[str, int]
+                      ) -> Tuple[Dict[str, Dict[str, int]],
+                                 Dict[str, int], Dict[str, int],
+                                 List[str]]:
+    """(new_allowlist, new_upcast, new_comm, change descriptions)."""
+    ran = set(report.rules_run)
+    counts = observed_counts(report)
+    changes: List[str] = []
+
+    new_allow: Dict[str, Dict[str, int]] = {}
+    for rule, files in allowlist.items():
+        if rule not in ran:
+            new_allow[rule] = dict(files)
+            continue
+        kept: Dict[str, int] = {}
+        for file, budget in files.items():
+            observed = counts.get((rule, file), 0)
+            new = min(budget, observed)
+            if new != budget:
+                changes.append(f"{rule}/{file}: {budget} -> {new}"
+                               + ("" if new else " (dropped)"))
+            if new > 0:
+                kept[file] = new
+        new_allow[rule] = kept
+
+    new_upcast = dict(upcast)
+    if "bf16-upcast" in ran:
+        for prog, budget in upcast.items():
+            st = report.graph_stats.get(prog, {}).get("bf16-upcast")
+            if not st:
+                continue
+            observed = int(st.get("elements", budget))
+            new = min(budget, observed)
+            if new != budget:
+                changes.append(f"UPCAST_BUDGET[{prog!r}]: "
+                               f"{budget} -> {new}")
+                new_upcast[prog] = new
+
+    new_comm = dict(comm)
+    if "collective-inventory" in ran:
+        for prog, rules in sorted(report.graph_stats.items()):
+            st = rules.get("collective-inventory")
+            if not st:
+                continue
+            observed = int(st.get("comm_bytes", 0))
+            if prog in new_comm:
+                new = min(new_comm[prog], observed)
+                if new != new_comm[prog]:
+                    changes.append(f"COMM_BUDGET[{prog!r}]: "
+                                   f"{new_comm[prog]} -> {new}")
+                    new_comm[prog] = new
+            elif observed > 0:
+                changes.append(f"COMM_BUDGET[{prog!r}]: "
+                               f"(unpinned) -> {observed}")
+                new_comm[prog] = observed
+
+    return new_allow, new_upcast, new_comm, changes
+
+
+def _render_str_int_dict(d: Dict[str, int], indent: str) -> List[str]:
+    return [f'{indent}"{k}": {d[k]},' for k in sorted(d)]
+
+
+def render_budgets(allowlist: Dict[str, Dict[str, int]],
+                   upcast: Dict[str, int],
+                   comm: Dict[str, int]) -> str:
+    """Serialize the three tables as a fresh budgets.py (stable order:
+    rule registration order is not meaningful, so everything sorts)."""
+    lines: List[str] = [BUDGET_HEADER]
+    lines.append("# Per-(rule, file) finding-count MAXIMA. Empty dict "
+                 "for a rule = zero")
+    lines.append("# tolerance everywhere (the silent-except contract "
+                 "since PR 9). Graph")
+    lines.append('# rules budget by pseudo-file "jaxpr:<program>".')
+    lines.append("ALLOWLIST: Dict[str, Dict[str, int]] = {")
+    for rule in sorted(allowlist):
+        files = allowlist[rule]
+        if not files:
+            lines.append(f'    "{rule}": {{}},')
+        else:
+            lines.append(f'    "{rule}": {{')
+            lines.extend(_render_str_int_dict(files, "        "))
+            lines.append("    },")
+    lines.append("}")
+    lines.append("")
+    lines.append("# bf16 -> f32 upcast element budgets per traced "
+                 "program (see framework.py")
+    lines.append("# for the audit doctrine); unpinned programs are "
+                 "report-only.")
+    lines.append("UPCAST_BUDGET: Dict[str, int] = {")
+    lines.extend(_render_str_int_dict(upcast, "    "))
+    lines.append("}")
+    lines.append("")
+    lines.append("# Static comm-model budgets: estimated per-device "
+                 "collective bytes per")
+    lines.append("# execution of a traced program (analysis/"
+                 "shard_rules.py documents the")
+    lines.append("# byte model); unpinned programs are report-only.")
+    lines.append("COMM_BUDGET: Dict[str, int] = {")
+    lines.extend(_render_str_int_dict(comm, "    "))
+    lines.append("}")
+    lines.append("")
+    return "\n".join(lines)

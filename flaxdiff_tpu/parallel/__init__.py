@@ -31,10 +31,12 @@ from .ulysses import (
     ulysses_self_attention,
 )
 from .partition import (
+    LeafAssignment,
     PartitionRule,
     fsdp_sharding_tree,
     infer_fsdp_spec,
     match_partition_rules,
+    partition_coverage,
     shard_pytree,
     sharding_tree,
     with_named_constraint,
@@ -58,10 +60,12 @@ __all__ = [
     "sequence_sharding",
     "local_batch_size",
     "mesh_shape_for",
+    "LeafAssignment",
     "PartitionRule",
     "match_partition_rules",
     "infer_fsdp_spec",
     "fsdp_sharding_tree",
+    "partition_coverage",
     "sharding_tree",
     "shard_pytree",
     "with_named_constraint",

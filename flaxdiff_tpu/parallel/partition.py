@@ -9,8 +9,9 @@ all-gather on use and reduce-scatter on gradient, i.e. ZeRO-3 over ICI.
 """
 from __future__ import annotations
 
+import dataclasses
 import re
-from typing import Optional, Sequence, Tuple, Union
+from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
 import numpy as np
@@ -177,6 +178,88 @@ def fsdp_sharding_tree(params: PyTree, mesh: Mesh,
         return infer_fsdp_spec(shape, mesh, axis, min_size)
 
     return jax.tree_util.tree_map_with_path(assign, params)
+
+
+# ---------------------------------------------------------------------------
+# Rule-coverage introspection: WHY each leaf got its spec, so the static
+# analyzer (flaxdiff_tpu/analysis/shard_rules.py `partition-coverage`)
+# can gate the one failure mode the inference path hides — a big tensor
+# that no rule and no inference matched, silently replicated into every
+# device's HBM.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class LeafAssignment:
+    """One param-tree leaf's partition decision and its provenance.
+
+    `source` is one of:
+      "rule"             an explicit (regex, PartitionSpec) rule matched
+      "tensor-parallel"  Megatron TP inference (`infer_tp_spec`)
+      "fsdp"             FSDP inference sharded a dimension
+      "replicated-small" below `min_size`: deliberately replicated
+                         (gather latency would beat the memory saved)
+      "unmatched"        at/over `min_size` but NO rule matched and no
+                         dimension divides the axis — silently
+                         replicated HBM on every device
+    """
+
+    path: str
+    shape: Tuple[int, ...]
+    nbytes: int
+    spec: PartitionSpec
+    source: str
+
+
+def partition_coverage(params: PyTree, mesh: Mesh,
+                       axis: str = AXIS_FSDP,
+                       rules: Optional[Sequence[PartitionRule]] = None,
+                       min_size: int = 2 ** 16) -> List[LeafAssignment]:
+    """Per-leaf provenance of `fsdp_sharding_tree`'s assignments.
+
+    Walks the same priority order (explicit rules, TP inference, FSDP
+    inference) and records which stage decided each leaf. The specs
+    agree with `fsdp_sharding_tree(params, mesh, axis, rules, min_size)`
+    leaf for leaf; this is the audit view, that is the executable one.
+    Returned sorted by path so reports are deterministic.
+    """
+    out: List[LeafAssignment] = []
+
+    def visit(path, leaf):
+        name = _path_str(path)
+        shape = tuple(int(s) for s in getattr(leaf, "shape", ()))
+        dtype = getattr(leaf, "dtype", None)
+        itemsize = int(getattr(dtype, "itemsize", 4) or 4)
+        nbytes = int(np.prod(shape, dtype=np.int64)) * itemsize \
+            if shape else itemsize
+        if rules is not None:
+            for pattern, spec in rules:
+                if re.search(pattern, name):
+                    out.append(LeafAssignment(name, shape, nbytes, spec,
+                                              "rule"))
+                    return leaf
+        tp_spec = infer_tp_spec(name, shape, mesh)
+        if tp_spec is not None:
+            out.append(LeafAssignment(name, shape, nbytes, tp_spec,
+                                      "tensor-parallel"))
+            return leaf
+        spec = infer_fsdp_spec(shape, mesh, axis, min_size)
+        if any(s is not None for s in spec):
+            source = "fsdp"
+        elif int(np.prod(shape, dtype=np.int64) if shape else 1) \
+                < min_size:
+            source = "replicated-small"
+        elif axis in mesh.axis_names and \
+                mesh.devices.shape[mesh.axis_names.index(axis)] > 1:
+            source = "unmatched"
+        else:
+            # a size-1 (or absent) shard axis replicates EVERYTHING by
+            # construction — nothing is silently unmatched on it
+            source = "replicated-small"
+        out.append(LeafAssignment(name, shape, nbytes, spec, source))
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, params)
+    return sorted(out, key=lambda a: a.path)
 
 
 def sharding_tree(spec_tree: PyTree, mesh: Mesh) -> PyTree:

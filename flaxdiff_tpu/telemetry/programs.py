@@ -21,6 +21,15 @@ record in `programs.jsonl` at trace/compile time:
                     — the hardware-FLOPs numerator); None elsewhere
     hbm_peak_bytes  allocator peak at registration
                     (`telemetry/memory.py`; None off-TPU)
+    collectives /   static comm model of the traced program
+    comm_bytes_by_axis
+                    (`analysis/shard_rules.collective_summary`: every
+                    psum/all_gather/reduce_scatter/ppermute/all_to_all
+                    in the jaxpr nest, scan-multiplied, with per-mesh-
+                    axis byte estimates) — gives the planner (ROADMAP 3)
+                    and `scripts/compare_runs.py` a comm/compute ratio
+                    per program; None / {} when the trace has no
+                    collectives or the probe failed
     fingerprint     hardware/platform fingerprint (below)
 
 This turns the single global `mfu_device` gauge into per-program
@@ -134,6 +143,8 @@ class ProgramRegistry:
                flops_cost: Optional[float] = None,
                bytes_cost: Optional[float] = None,
                hbm_peak_bytes: Optional[float] = None,
+               collectives: Optional[int] = None,
+               comm_bytes_by_axis: Optional[Dict[str, int]] = None,
                extra: Optional[Dict[str, Any]] = None
                ) -> Optional[Dict[str, Any]]:
         """Register one program; returns the row, or None when (kind,
@@ -150,6 +161,11 @@ class ProgramRegistry:
                            if bytes_cost is not None else None),
             "hbm_peak_bytes": (float(hbm_peak_bytes)
                                if hbm_peak_bytes is not None else None),
+            "collectives": (int(collectives)
+                            if collectives is not None else None),
+            "comm_bytes_by_axis": {
+                str(k): int(v)
+                for k, v in sorted((comm_bytes_by_axis or {}).items())},
             "fingerprint": self.fingerprint(),
         }
         if extra:
@@ -183,6 +199,8 @@ class ProgramRegistry:
             if (str(kind), str(key)) in self._rows:
                 return None
         flops_jaxpr = flops_cost = bytes_cost = None
+        collectives: Optional[int] = None
+        comm_by_axis: Optional[Dict[str, int]] = None
         try:
             import jax
 
@@ -190,8 +208,17 @@ class ProgramRegistry:
             closed = jax.make_jaxpr(jitted)(*args)
             flops_jaxpr = jaxpr_flops(closed.jaxpr)
         except Exception as e:  # noqa: BLE001 — evidence is best-effort
+            closed = None
             flops_jaxpr = None
             _note_probe_failure("jaxpr", kind, e)
+        if closed is not None:
+            try:
+                from ..analysis.shard_rules import collective_summary
+                comm = collective_summary(closed)
+                collectives = int(comm["collectives"])
+                comm_by_axis = dict(comm["comm_bytes_by_axis"])
+            except Exception as e:  # noqa: BLE001 — static model only
+                _note_probe_failure("collectives", kind, e)
         if self.deep:
             try:
                 cost = jitted.lower(*args).compile().cost_analysis()
@@ -213,7 +240,8 @@ class ProgramRegistry:
         return self.record(kind, key, compile_ms=compile_ms,
                            flops_jaxpr=flops_jaxpr,
                            flops_cost=flops_cost, bytes_cost=bytes_cost,
-                           hbm_peak_bytes=hbm, extra=extra)
+                           hbm_peak_bytes=hbm, collectives=collectives,
+                           comm_bytes_by_axis=comm_by_axis, extra=extra)
 
     # -- views --------------------------------------------------------------
     def rows(self) -> List[Dict[str, Any]]:

@@ -532,10 +532,18 @@ class DiffusionTrainer:
                 continue
             registered.add(kind)
             flops_jaxpr = None
+            collectives = comm_by_axis = None
             try:
                 with use_mesh(self.mesh):
                     closed = jax.make_jaxpr(prog)(self.state, batch)
                 flops_jaxpr = jaxpr_flops(closed.jaxpr)
+                from ..analysis.shard_rules import collective_summary
+                comm = collective_summary(
+                    closed, dict(zip(self.mesh.axis_names,
+                                     self.mesh.devices.shape))
+                    if self.mesh is not None else None)
+                collectives = int(comm["collectives"])
+                comm_by_axis = dict(comm["comm_bytes_by_axis"])
             except Exception as e:  # noqa: BLE001 — evidence is
                 # best-effort; a failed probe degrades the field only
                 import logging
@@ -550,6 +558,8 @@ class DiffusionTrainer:
                 flops_cost=(flops_cost if kind == "train_step"
                             else None),
                 hbm_peak_bytes=hbm,
+                collectives=collectives,
+                comm_bytes_by_axis=comm_by_axis,
                 extra={"compile_source": "first_step_busy"})
 
     # -- checkpointing -------------------------------------------------------

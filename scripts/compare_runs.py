@@ -71,6 +71,12 @@ _NEUTRAL = ("seed", "count", "n_requests", "rate_hz", "batch", "steps",
             "rounds", "requests", "completed", "incarnation", "epoch",
             "devices", "world", "num_", "resolution", "nfe", "secs",
             "budget", "attempts", "image_size", "flops")
+# neutral checked on the FULL path (before the generic "bytes"-is-worse
+# heuristic): the static comm model (`collectives`,
+# `comm_bytes_by_axis/<axis>`) describes the PROGRAM, not the run — a
+# change means the program changed shape, which the lint comm budgets
+# gate; here it is reported informationally, never as a regression
+_NEUTRAL_PATH = ("comm_bytes", "collectives")
 
 
 def direction(path: str) -> int:
@@ -78,6 +84,9 @@ def direction(path: str) -> int:
     candidate is LOWER, 0 = informational."""
     p = path.lower()
     leaf = p.rsplit("/", 1)[-1]
+    for frag in _NEUTRAL_PATH:
+        if frag in p:
+            return 0
     for frag in _NEUTRAL:
         if frag in leaf:
             return 0
@@ -180,11 +189,13 @@ def load_telemetry_dir(path: str) -> Dict[str, Any]:
         if not fp and isinstance(row.get("fingerprint"), dict):
             fp = dict(row["fingerprint"])
         ident = f"{row.get('kind', '?')}::{row.get('key', '?')}"
-        programs[ident] = _flatten(
-            {k: row[k] for k in ("compile_ms", "flops_jaxpr",
-                                 "flops_cost", "bytes_cost",
-                                 "hbm_peak_bytes")
-             if isinstance(row.get(k), (int, float))})
+        fields = {k: row[k] for k in ("compile_ms", "flops_jaxpr",
+                                      "flops_cost", "bytes_cost",
+                                      "hbm_peak_bytes", "collectives")
+                  if isinstance(row.get(k), (int, float))}
+        if isinstance(row.get("comm_bytes_by_axis"), dict):
+            fields["comm_bytes_by_axis"] = row["comm_bytes_by_axis"]
+        programs[ident] = _flatten(fields)
     out = {"kind": "telemetry", "fingerprint": fp, "stages": stages}
     if programs:
         out["programs"] = programs

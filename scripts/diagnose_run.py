@@ -341,7 +341,8 @@ def programs_section(programs: List[Dict], lines: List[str]) -> None:
                  + (f" {fp['device_kind']}" if fp.get("device_kind")
                     else "") + ") ==")
     lines.append(f"{'kind':<22s} {'compile ms':>11s} {'GFLOP jaxpr':>12s} "
-                 f"{'GFLOP cost':>11s} {'key':<s}")
+                 f"{'GFLOP cost':>11s} {'coll':>5s} {'comm KiB/axis':>16s} "
+                 f"{'key':<s}")
     for p in sorted(programs,
                     key=lambda r: (str(r.get("kind")), str(r.get("key")))):
         def gf(name, p=p):
@@ -350,11 +351,19 @@ def programs_section(programs: List[Dict], lines: List[str]) -> None:
                 else "-"
         cm = p.get("compile_ms")
         key = str(p.get("key", ""))
+        coll = p.get("collectives")
+        by_axis = p.get("comm_bytes_by_axis") or {}
+        # static comm model columns (analysis/shard_rules.py): dispatch
+        # count + per-mesh-axis byte estimate per execution
+        comm = " ".join(f"{a}={by_axis[a] / 1024.0:.1f}"
+                        for a in sorted(by_axis)) if by_axis else "-"
         lines.append(
             f"{str(p.get('kind', '?')):<22s} "
             f"{(f'{cm:.1f}' if isinstance(cm, (int, float)) else '-'):>11s} "
             f"{gf('flops_jaxpr'):>12s} {gf('flops_cost'):>11s} "
-            f"{key[:60] + ('…' if len(key) > 60 else '')}")
+            f"{(str(coll) if isinstance(coll, int) else '-'):>5s} "
+            f"{comm:>16s} "
+            f"{key[:48] + ('…' if len(key) > 48 else '')}")
     lines.append("")
 
 

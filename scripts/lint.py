@@ -5,13 +5,17 @@ Thin launcher over `flaxdiff_tpu.analysis.cli` (also reachable as
 `python -m flaxdiff_tpu.analysis`). Runs every AST rule (host-sync
 hygiene, never-lane-slice, silent-except, metric-name drift) over the
 production tree AND the jaxpr analyzers (RNG-key reuse, callback
-leaks, bf16->f32 upcast audit) over the real traced hot programs.
-Exit 0 = clean; 1 = over-budget findings. See docs/ANALYSIS.md.
+leaks, bf16->f32 upcast audit, collective-traffic inventory,
+partition-rule coverage, implicit-resharding detection) over the real
+traced hot programs — including the MESHED parallel programs under a
+forced 8-device CPU host platform. Exit 0 = clean; 1 = over-budget
+findings. See docs/ANALYSIS.md.
 
 Usage:
     python scripts/lint.py                # everything
     python scripts/lint.py --json         # stable machine output
     python scripts/lint.py --list-rules   # the rule catalogue
+    python scripts/lint.py --tighten      # shrink budgets to observed
 """
 import os
 import sys

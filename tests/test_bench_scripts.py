@@ -6,8 +6,10 @@ scripts/bench_sampler_trace.py: #7) — CI proves the harnesses execute
 end to end and emit the JSON shape the evidence pipeline expects.
 """
 import json
+import os
 
 import numpy as np
+import pytest
 
 
 def test_sweep256_records_every_batch(tmp_path, capsys):
@@ -48,12 +50,16 @@ def test_sfc_demo_renders(tmp_path):
     assert out.stat().st_size > 10_000
 
 
+@pytest.mark.skipif(
+    not os.path.isdir("/root/reference/flaxdiff"),
+    reason="reference flaxdiff package not present at /root/reference "
+           "(bench_reference.py imports it from there; same honest-skip "
+           "doctrine as the PR-7 interpret-hook skips)")
 def test_reference_binary_compat_patch_runs():
     """The ACTUAL reference trainer must keep running under this image's
     jax via scripts/bench_reference.py's documented 1-line in-memory
     patch (the refreal bench stage depends on it; /root/reference is
     never modified)."""
-    import os
     import subprocess
     import sys
 

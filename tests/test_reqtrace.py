@@ -374,7 +374,9 @@ def test_diagnose_run_reqtrace_and_programs_sections(tmp_path, capsys):
                       "outcome": "shed:deadline", "queue_ms": 50.0,
                       "sampler": "ddim", "nfe": 4, "resolution": 8})
     tel.programs.record("chunk", ("chunk", 2, 2), compile_ms=123.4,
-                        flops_jaxpr=2.5e9, flops_cost=3.0e9)
+                        flops_jaxpr=2.5e9, flops_cost=3.0e9,
+                        collectives=8,
+                        comm_bytes_by_axis={"seq": 4096})
     tel.close()
 
     assert main([str(tmp_path)]) == 0
@@ -384,6 +386,9 @@ def test_diagnose_run_reqtrace_and_programs_sections(tmp_path, capsys):
     assert "round    1 chunk" in out and "MISS" in out
     assert "== Programs (1 registered" in out
     assert "2.500" in out and "123.4" in out
+    # static comm model columns (ISSUE 14): dispatch count + KiB/axis
+    assert "comm KiB/axis" in out
+    assert "seq=4.0" in out
 
     assert main([str(tmp_path), "--json"]) == 0
     doc = json.loads(capsys.readouterr().out)
@@ -392,3 +397,5 @@ def test_diagnose_run_reqtrace_and_programs_sections(tmp_path, capsys):
     assert doc["request_traces"]["spans"]["latency_ms"]["p50"] == 16.0
     assert doc["request_traces"]["slowest"]["trace_id"] == "req-1-0"
     assert doc["programs"][0]["kind"] == "chunk"
+    assert doc["programs"][0]["collectives"] == 8
+    assert doc["programs"][0]["comm_bytes_by_axis"] == {"seq": 4096}

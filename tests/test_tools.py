@@ -149,10 +149,12 @@ def test_bench_sigterm_emits_final_line(tmp_path):
 # AND the jaxpr analyzers over the real traced hot programs.
 
 def test_repo_lint_clean_unified(capsys):
-    """ISSUE 9 acceptance: `scripts/lint.py` exits 0 on the repo with
-    an EMPTY silent-except allowlist, and the jaxpr analyzers report
-    zero RNG-reuse / callback findings on the real train-step and
-    sampler chunk programs."""
+    """ISSUE 9 + ISSUE 14 acceptance: `scripts/lint.py` exits 0 on the
+    repo with an EMPTY silent-except allowlist, the jaxpr analyzers
+    report zero RNG-reuse / callback findings on the real train-step
+    and sampler chunk programs, and the sharding rules report zero
+    partition-coverage / implicit-reshard findings with pinned
+    collective budgets on every MESHED parallel program."""
     from scripts.lint import main
     assert main(["--json"]) == 0
     data = json.loads(capsys.readouterr().out)
@@ -166,25 +168,51 @@ def test_repo_lint_clean_unified(capsys):
                  "chunk_euler_ancestral"):
         assert graph[prog]["rng-key-reuse"]["reused"] == 0, prog
         assert graph[prog]["callback-leak"]["callbacks"] == 0, prog
+    # the meshed inventory traced, its comm models are pinned, and no
+    # sharding finding survived (coverage + reshard findings would have
+    # flipped ok above; assert the stats landed so a silently-skipped
+    # meshed trace can't fake a pass)
+    for prog in ("meshed_ring_attention", "meshed_ring_attention_grad",
+                 "meshed_ulysses_attention", "meshed_pipeline"):
+        ci = graph[prog]["collective-inventory"]
+        assert ci["collectives"] > 0 and "budget" in ci, prog
+        assert graph[prog]["implicit-reshard"]["reshards"] == 0, prog
+    cov = graph["meshed_train_step_fsdp"]["partition-coverage"]
+    assert cov["leaves"] > 0 and cov.get("unmatched", 0) == 0
+    assert not any(f["rule"] in ("partition-coverage",
+                                 "implicit-reshard")
+                   for f in data["findings"])
 
 
 def test_lint_json_output_is_stable(capsys):
     """--json is for machines: two runs on an unchanged tree must be
-    byte-identical (sorted findings, no timestamps, no abs paths)."""
+    byte-identical (sorted findings, no timestamps, no abs paths) —
+    including the graph section's collective inventories (ISSUE 14:
+    the static comm model is a pinned artifact, not a measurement)."""
     from scripts.lint import main
     assert main(["--json", "--no-graph"]) == 0
     first = capsys.readouterr().out
     assert main(["--json", "--no-graph"]) == 0
     assert capsys.readouterr().out == first
     json.loads(first)       # and it parses
+    # graph included (program builders are lru-cached, so the second
+    # full run only re-walks the jaxprs): still byte-identical
+    assert main(["--json"]) == 0
+    g1 = capsys.readouterr().out
+    assert main(["--json"]) == 0
+    assert capsys.readouterr().out == g1
+    graph = json.loads(g1)["graph"]
+    ci = graph["meshed_ring_attention"]["collective-inventory"]
+    assert ci["comm_bytes_by_axis"] == {"seq": 4096}
 
 
 # -- evidence diff CLI (scripts/compare_runs.py; ISSUE 13) --------------------
 
 def _telemetry_fixture(tmp_path, name, latency_p50, compile_ms,
-                       platform="cpu"):
+                       platform="cpu", comm_bytes=4096):
     """A minimal telemetry dir: one metrics snapshot + a programs.jsonl
-    row, values parameterized so the pair can regress on demand."""
+    row (static comm model included), values parameterized so the pair
+    can regress on demand."""
     d = tmp_path / name
     d.mkdir()
     rows = [
@@ -203,6 +231,8 @@ def _telemetry_fixture(tmp_path, name, latency_p50, compile_ms,
             "compile_ms": compile_ms, "flops_jaxpr": 1e9,
             "flops_cost": None, "bytes_cost": None,
             "hbm_peak_bytes": None,
+            "collectives": 8,
+            "comm_bytes_by_axis": {"seq": comm_bytes},
             "fingerprint": {"platform": platform,
                             "device_kind": platform, "jax": "0"}}
     with open(d / "programs.jsonl", "w") as f:
@@ -239,6 +269,29 @@ def test_compare_runs_regression_exit_code(tmp_path, capsys):
     capsys.readouterr()
     # improvement direction: candidate FASTER is never a regression
     assert main([worse, a]) == 0
+
+
+def test_compare_runs_comm_model_is_neutral(tmp_path, capsys):
+    """ISSUE 14 acceptance: `comm_bytes_by_axis` / `collectives` rows
+    round-trip through the evidence diff as INFORMATIONAL — a comm-model
+    change means the program changed shape (the lint budgets gate that),
+    never a run regression — while real latency regressions in the same
+    pair still fail."""
+    from scripts.compare_runs import main
+    a = _telemetry_fixture(tmp_path, "a", 10.0, 100.0, comm_bytes=4096)
+    b = _telemetry_fixture(tmp_path, "b", 10.0, 100.0,
+                           comm_bytes=999999)
+    assert main([a, b, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    rows = {r["metric"]: r for r in doc["programs"]["rows"]}
+    assert rows["comm_bytes_by_axis/seq"]["direction"] == "info"
+    assert rows["comm_bytes_by_axis/seq"]["regressed"] is False
+    assert rows["collectives"]["direction"] == "info"
+    # the neutrality is scoped: a latency regression alongside the comm
+    # drift still fails the comparison
+    worse = _telemetry_fixture(tmp_path, "worse", 30.0, 100.0,
+                               comm_bytes=999999)
+    assert main([a, worse]) == 1
 
 
 def test_compare_runs_fingerprint_mismatch(tmp_path, capsys):
