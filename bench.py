@@ -1729,6 +1729,54 @@ def stage_serve(args) -> dict:
             sched2.close()
         res["prewarmed_retrace_free"] = bool(
             res.get("prewarmed", {}).get("re_traces", 1) == 0)
+    if args.serve_chaos:
+        # chaos-replay phase (ISSUE 15): the identical workload under
+        # injected round / fetch / device faults. Acceptance: zero
+        # stranded futures (every request resolves: completed, shed,
+        # or typed fault), the device-lost round triggers exactly one
+        # supervised engine rebuild (prewarmed — rebuilt traffic pays
+        # no re-trace on the request path), and recovered requests
+        # (attempts > 0) report their own p99.
+        from flaxdiff_tpu import resilience as R
+        tel4 = Telemetry(enabled=False)
+        sched4 = ServingScheduler(
+            pipeline=DiffusionInferencePipeline.from_config(
+                config, params=params),
+            config=SchedulerConfig(round_steps=4, batch_buckets=(4,),
+                                   max_inflight=2),
+            telemetry=tel4, autostart=False)
+        try:
+            protos, seen = [], set()
+            for _, req in workload:
+                sig = (req.diffusion_steps, req.sampler)
+                if sig not in seen:
+                    seen.add(sig)
+                    protos.append(req)
+            sched4.prewarm(protos)
+            sched4.start()
+            tel, sched = tel4, sched4
+            fault_plan = R.FaultPlan([
+                R.FaultSpec("serving.round", at=(3,), times=1),
+                R.FaultSpec("serving.fetch", at=(2,), times=1),
+                R.FaultSpec("serving.device_lost", at=(6,), times=1,
+                            error="flag")], seed=0)
+            with fault_plan.installed():
+                summary = run_phase("chaos", workload)
+        finally:
+            sched4.close()
+        snap4 = tel4.registry.snapshot()
+        summary["rebuilds"] = snap4.get(
+            "serving/supervisor_rebuilds", 0)
+        summary["requeued"] = snap4.get("serving/requeued", 0)
+        summary["quarantined"] = snap4.get("serving/quarantined", 0)
+        res["chaos_zero_stranded"] = bool(
+            summary["completed"] + summary["shed"]
+            + summary["faulted"] + summary["errors"] == n)
+        res["chaos_recovered_p99_ms"] = summary["recovered_p99_ms"]
+        log(f"serve chaos: recovered={summary['recovered']} "
+            f"p99={summary['recovered_p99_ms']} ms, "
+            f"rebuilds={summary['rebuilds']}, "
+            f"zero_stranded={res['chaos_zero_stranded']}")
     res["warm_retrace_free"] = bool(
         res.get("warm", {}).get("re_traces", 1) == 0)
     res["cached_warm_retrace_free"] = bool(
@@ -1939,6 +1987,13 @@ def run_stage(name: str, args, env, timeout_s: int, retries: int,
            "--trace", args.trace]
     if args.quick:
         cmd.append("--quick")
+    # serve-stage opt-in phases ride along (previously they only
+    # worked in direct `--stage serve` child mode)
+    if name == "serve":
+        if getattr(args, "serve_prewarm", False):
+            cmd.append("--serve_prewarm")
+        if getattr(args, "serve_chaos", False):
+            cmd.append("--serve_chaos")
     last = "never ran"
     killed_prev = False
     for attempt in range(1 + retries):
@@ -2062,6 +2117,13 @@ def main():
     # p50 from the first request). Off by default: it re-compiles the
     # composed program family, ~1 extra cold pass of stage budget.
     ap.add_argument("--serve_prewarm", action="store_true")
+    # serve stage: also run a chaos-replay phase — the same workload
+    # under injected round/fetch/device faults (FaultPlan), reporting
+    # recovered-request p99, rebuild count, and the zero-stranded
+    # acceptance (docs/SERVING.md "Failure semantics"). Off by
+    # default: the device-lost rebuild re-runs prewarm (~1 extra cold
+    # compile pass of stage budget).
+    ap.add_argument("--serve_chaos", action="store_true")
     # stamp the final result with a hardware/software fingerprint
     # (platform, device kind, jax version) so scripts/compare_runs.py
     # can refuse to diff evidence from different experiments — two

@@ -22,6 +22,17 @@ Known sites (the framework's barriers; plans may name new ones freely):
     coord.local_valid  Checkpointer.locally_valid_steps: drops the
                   newest step from THIS host's consensus-restore input
                   (asymmetric-corruption chaos; arm on one host only)
+    serving.round  ServingScheduler dispatch: polled once per row per
+                  round with key="seed:<seed>:" — a per_key spec
+                  poisons ONE request deterministically (conviction by
+                  binary-search solo re-runs), a site-global `at`
+                  models a transient round fault
+    serving.fetch  ServingScheduler completion thread, before the
+                  blessed host sync — a failed readback requeues the
+                  batch for bit-exact replay
+    serving.device_lost  ServingScheduler dispatch, before each round
+                  (use error="flag"): raises DeviceLost -> the
+                  EngineSupervisor drains, rebuilds, prewarms, requeues
 
 A plan is JSON-serializable and env-drivable::
 

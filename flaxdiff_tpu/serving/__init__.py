@@ -1,13 +1,18 @@
 """Serving subsystem: a batched sampler scheduler in front of
 `DiffusionInferencePipeline` (docs/SERVING.md).
 
-    scheduler  thread-safe queue -> micro-batch rounds with continuous
-               admission (per-row NFE masking), bucketed padding,
-               bounded in-flight dispatch, deadline shedding
-    engine     compiled-program cache over the single-scan
-               DiffusionSampler, keyed so repeat traffic never
-               re-traces; per-request device carries
-    loadgen    seeded Poisson workload build + replay (bench.py serve)
+    scheduler    thread-safe queue -> micro-batch rounds with
+                 continuous admission (per-row NFE masking), bucketed
+                 padding, bounded in-flight dispatch, deadline
+                 shedding, fault-isolated rounds
+    engine       compiled-program cache over the single-scan
+                 DiffusionSampler, keyed so repeat traffic never
+                 re-traces; per-request device carries
+    supervision  fault taxonomy (`ServingFault`/`classify`), engine
+                 supervision/rebuild (`EngineSupervisor`), brownout
+                 degradation (`BrownoutPolicy`) — docs/SERVING.md
+                 "Failure semantics"
+    loadgen      seeded Poisson workload build + replay (bench.py serve)
 
 SLO metrics ride the telemetry registry under `serving/*`
 (docs/OBSERVABILITY.md).
@@ -18,11 +23,15 @@ from .loadgen import PoissonWorkloadSpec, build_workload, replay
 from .request import (DeadlineExceeded, SampleRequest, SampleResult,
                       SchedulerClosed, ServingFuture)
 from .scheduler import MS_BUCKET_BOUNDS, SchedulerConfig, ServingScheduler
+from .supervision import (BrownoutConfig, BrownoutPolicy, DeviceLost,
+                          EngineSupervisor, ServingFault, classify)
 
 __all__ = [
-    "DEFAULT_BATCH_BUCKETS", "DeadlineExceeded", "MS_BUCKET_BOUNDS",
-    "PoissonWorkloadSpec", "RequestState", "SampleRequest",
-    "SampleResult", "SamplerProgramEngine", "SchedulerClosed",
-    "SchedulerConfig", "ServingFuture", "ServingScheduler",
-    "bucket_up", "build_workload", "nfe_bucket", "replay",
+    "BrownoutConfig", "BrownoutPolicy", "DEFAULT_BATCH_BUCKETS",
+    "DeadlineExceeded", "DeviceLost", "EngineSupervisor",
+    "MS_BUCKET_BOUNDS", "PoissonWorkloadSpec", "RequestState",
+    "SampleRequest", "SampleResult", "SamplerProgramEngine",
+    "SchedulerClosed", "SchedulerConfig", "ServingFault",
+    "ServingFuture", "ServingScheduler", "bucket_up", "build_workload",
+    "classify", "nfe_bucket", "replay",
 ]

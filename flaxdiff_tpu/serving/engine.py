@@ -67,7 +67,7 @@ class RequestState:
                  "x", "rng", "state", "pairs", "terminal_t", "nfe",
                  "done", "cond", "uncond", "compile_ms", "rounds",
                  "first_dispatch_t", "plan", "flags", "taps", "codes",
-                 "ref", "trace")
+                 "ref", "trace", "attempts", "orig_req", "degraded")
 
     def __init__(self, req: SampleRequest, future: ServingFuture,
                  submit_t: float, admit_t: float, group: tuple,
@@ -105,6 +105,13 @@ class RequestState:
         # request-scoped trace accumulator (telemetry/reqtrace.py);
         # None on the disabled hub — the scheduler attaches it
         self.trace = None
+        # serving resilience (serving/supervision.py), attached by the
+        # scheduler after prepare: failed-attempt count carried across
+        # requeues, the pre-brownout request for bit-exact replay, and
+        # the brownout degradation flags surfaced on SampleResult
+        self.attempts = 0
+        self.orig_req = req
+        self.degraded: tuple = ()
 
     @property
     def remaining(self) -> int:

@@ -15,6 +15,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .request import DeadlineExceeded, SampleRequest, SampleResult
+from .supervision import ServingFault
 
 
 @dataclasses.dataclass
@@ -60,15 +61,23 @@ def replay(scheduler, workload: List[Tuple[float, SampleRequest]],
             time.sleep(delay)
         futures.append(scheduler.submit(req))
     results: List[SampleResult] = []
-    shed = errors = 0
+    shed = faulted = errors = 0
     for fut in futures:
         try:
             results.append(fut.result(timeout=timeout_s))
         except DeadlineExceeded:
             shed += 1
+        except ServingFault:
+            # typed terminal fault (quarantine / retries exhausted /
+            # device lost without a rebuild path) — the future
+            # RESOLVED, it was not stranded
+            faulted += 1
         except Exception:
             errors += 1
     wall = time.perf_counter() - t0
+    # recovery accounting (docs/SERVING.md "Failure semantics"):
+    # completions that rode at least one retry, and their tail latency
+    recovered = [r for r in results if r.attempts > 0]
 
     lat = [r.latency_ms for r in results]
     samples = sum(int(np.asarray(r.samples).shape[0]) for r in results)
@@ -76,7 +85,11 @@ def replay(scheduler, workload: List[Tuple[float, SampleRequest]],
         "requests": len(workload),
         "completed": len(results),
         "shed": shed,
+        "faulted": faulted,
         "errors": errors,
+        "recovered": len(recovered),
+        "recovered_p99_ms": _pct([r.latency_ms for r in recovered], 99),
+        "degraded": sum(1 for r in results if r.degraded),
         "wall_s": round(wall, 3),
         "throughput_rps": round(len(results) / wall, 3) if wall else None,
         "samples_per_s": round(samples / wall, 3) if wall else None,

@@ -86,6 +86,12 @@ class SampleResult:
                device execution of every round to result readiness
     latency_ms submit -> samples ready on host
     rounds     scheduler rounds the request participated in
+    attempts   failed dispatch attempts that were retried before this
+               result (0 on the healthy path) — each retry replayed
+               the trajectory bit-exactly from the request's seed
+    degraded   brownout flags ("nfe_capped", "plan_forced", ...) when
+               admission degraded the request instead of shedding it
+               (docs/SERVING.md "Failure semantics"); empty otherwise
     """
     samples: np.ndarray
     request: SampleRequest
@@ -94,6 +100,8 @@ class SampleResult:
     device_ms: float = 0.0
     latency_ms: float = 0.0
     rounds: int = 0
+    attempts: int = 0
+    degraded: tuple = ()
 
     def timings(self) -> Dict[str, float]:
         return {"queue_ms": self.queue_ms, "compile_ms": self.compile_ms,
@@ -101,20 +109,35 @@ class SampleResult:
 
 
 class ServingFuture:
-    """Minimal thread-safe future for one request's result."""
+    """Minimal thread-safe future for one request's result.
+
+    First set wins: once resolved (result OR exception) later sets are
+    ignored — the failure-isolation sweeps (dispatch-thread death,
+    non-draining close, engine rebuild) may race the completion
+    thread's delivery, and a delivered result must never be clobbered
+    by a later blanket failure."""
 
     def __init__(self):
+        self._lock = threading.Lock()
         self._event = threading.Event()
         self._result: Optional[SampleResult] = None
         self._exception: Optional[BaseException] = None
 
-    def set_result(self, result: SampleResult) -> None:
-        self._result = result
-        self._event.set()
+    def set_result(self, result: SampleResult) -> bool:
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._result = result
+            self._event.set()
+            return True
 
-    def set_exception(self, exc: BaseException) -> None:
-        self._exception = exc
-        self._event.set()
+    def set_exception(self, exc: BaseException) -> bool:
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._exception = exc
+            self._event.set()
+            return True
 
     def done(self) -> bool:
         return self._event.is_set()

@@ -25,6 +25,17 @@ Architecture (docs/SERVING.md):
   `serving/backpressure_waits`) instead of racing the device.
 - **close(drain=True)** stops admission, finishes queued + active
   work, and joins both threads.
+
+Failure semantics (docs/SERVING.md "Failure semantics",
+serving/supervision.py): every round and completion fetch is a fault
+barrier — a failing round poisons only its group, suspect requests are
+convicted by binary-search solo re-runs (deterministic given seed),
+innocent rows requeue with bounded attempts + backoff, device loss
+drains and rebuilds the engine (prewarmed) under an
+`EngineSupervisor`, and brownout degradation turns quality knobs
+before anything is shed. No future is ever stranded: results,
+`DeadlineExceeded`, `SchedulerClosed`, or a typed `ServingFault` —
+even if a scheduler thread dies (chaos-tested).
 """
 from __future__ import annotations
 
@@ -32,13 +43,19 @@ import dataclasses
 import threading
 import time
 from collections import deque
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
+from ..resilience import faults as _faults
+from ..resilience.events import record_event
+from ..resilience.retry import RetryPolicy
 from ..telemetry.reqtrace import RequestTracer
 from .engine import (DEFAULT_BATCH_BUCKETS, RequestState,
                      SamplerProgramEngine, bucket_up, nfe_bucket)
 from .request import (DeadlineExceeded, SampleRequest, SampleResult,
                       SchedulerClosed, ServingFuture)
+from .supervision import (BrownoutConfig, BrownoutPolicy, DeviceLost,
+                          DRAINING, EngineSupervisor, SERVING,
+                          ServingFault, classify)
 
 # Millisecond-scale SLO latency buckets (the registry default bounds
 # are seconds-scale training phases).
@@ -80,12 +97,41 @@ class SchedulerConfig:
     max_queue: admission cap; submits past it are shed at the door.
     max_inflight: completed batches allowed in flight to the
       completion thread before the dispatch loop backpressures.
+    retry: bounded requeue budget + backoff schedule for
+      failed-but-innocent requests (resilience/retry.py); a request's
+      `attempts`-th failure requeues with `delays()[attempts-1]` of
+      backoff until `max_attempts` is reached, then its future fails
+      with `ServingFault(kind="retries_exhausted")`. Jitter is off by
+      default so chaos replays are exactly deterministic.
+    brownout: degradation thresholds (serving/supervision.py), or
+      None to disable degrade-before-shed entirely.
     """
     round_steps: int = 8
     batch_buckets: Tuple[int, ...] = DEFAULT_BATCH_BUCKETS
     max_queue: int = 256
     max_inflight: int = 2
     drain_timeout_s: float = 120.0
+    retry: RetryPolicy = dataclasses.field(
+        default_factory=lambda: RetryPolicy(
+            max_attempts=3, base_delay=0.05, max_delay=2.0, jitter=0.0))
+    brownout: Optional[BrownoutConfig] = dataclasses.field(
+        default_factory=BrownoutConfig)
+
+
+@dataclasses.dataclass
+class _Pending:
+    """One queued request: the effective (possibly brownout-degraded)
+    request, its future, submit timestamp, trace accumulator, failed
+    attempts so far, original pre-degradation request, earliest
+    re-dispatch time (retry backoff), and degradation flags."""
+    req: SampleRequest
+    fut: ServingFuture
+    t_sub: float
+    trace: Any = None
+    attempts: int = 0
+    orig_req: Optional[SampleRequest] = None
+    not_before: float = 0.0
+    degraded: Tuple[str, ...] = ()
 
 
 class ServingScheduler:
@@ -97,15 +143,25 @@ class ServingScheduler:
 
     def __init__(self, pipeline=None, engine=None,
                  config: Optional[SchedulerConfig] = None,
-                 telemetry=None, autostart: bool = True):
+                 telemetry=None, autostart: bool = True,
+                 engine_factory=None):
+        if telemetry is None:
+            from ..telemetry import global_telemetry
+            telemetry = global_telemetry()
         if engine is None:
             if pipeline is None:
                 raise ValueError("need a pipeline or an engine")
             engine = SamplerProgramEngine(pipeline, telemetry=telemetry)
-        if telemetry is None:
-            from ..telemetry import global_telemetry
-            telemetry = global_telemetry()
+            if engine_factory is None:
+                # device loss tears the whole compiled-program cache
+                # down with the engine — a fresh engine over the same
+                # pipeline is the rebuild unit
+                engine_factory = lambda: SamplerProgramEngine(  # noqa: E731
+                    pipeline, telemetry=telemetry)
         self.engine = engine
+        # None means device loss cannot rebuild: interrupted futures
+        # fail with ServingFault(kind="device_lost") instead of hanging
+        self.engine_factory = engine_factory
         self.config = config or SchedulerConfig()
         self.telemetry = telemetry
         # request-scoped tracing (telemetry/reqtrace.py): every call is
@@ -113,12 +169,13 @@ class ServingScheduler:
         # performs the IDENTICAL seam-counted host syncs as an untraced
         # one (counting-mock tested) — tracing is host bookkeeping only
         self.tracer = RequestTracer(telemetry)
+        self.supervisor = EngineSupervisor(telemetry)
+        self.brownout = (BrownoutPolicy(self.config.brownout, telemetry)
+                         if self.config.brownout is not None else None)
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        # queue entries: (request, future, submit_time, trace-or-None)
-        self._queue: Deque[Tuple[SampleRequest, ServingFuture, float,
-                                 object]] = deque()
+        self._queue: Deque[_Pending] = deque()
         self._active: Dict[tuple, List[RequestState]] = {}
         self._completions: Deque[Tuple[List[RequestState], object, float]] \
             = deque()
@@ -127,6 +184,8 @@ class ServingScheduler:
         self._closed = False
         self._draining = False
         self._dispatch_done = False
+        self._processing = False     # completion thread mid-batch
+        self._prewarm_args = None    # (protos, round_steps, buckets)
 
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="serving-dispatch",
@@ -145,7 +204,11 @@ class ServingScheduler:
         this scheduler's `round_steps`/`batch_buckets` config — BEFORE
         admission opens, so cold p50 never hits user traffic. Call
         before (or after) `start()`, but before submitting; delegates
-        to `SamplerProgramEngine.prewarm`."""
+        to `SamplerProgramEngine.prewarm`. The prototypes are recorded:
+        an engine rebuild after device loss replays the same prewarm,
+        so rebuilt traffic is also retrace-free."""
+        self._prewarm_args = (list(reqs), self.config.round_steps,
+                              self.config.batch_buckets)
         return self.engine.prewarm(reqs, self.config.round_steps,
                                    self.config.batch_buckets)
 
@@ -174,8 +237,8 @@ class ServingScheduler:
                 # nothing will ever drain an unstarted scheduler —
                 # resolve pending futures instead of leaving waiters
                 # hanging
-                for _, fut, _, _ in self._queue:
-                    fut.set_exception(SchedulerClosed("scheduler closed"))
+                for e in self._queue:
+                    e.fut.set_exception(SchedulerClosed("scheduler closed"))
                 self._queue.clear()
                 for rows in self._active.values():
                     for r in rows:
@@ -194,7 +257,11 @@ class ServingScheduler:
     # -- admission ------------------------------------------------------------
     def submit(self, req: SampleRequest) -> ServingFuture:
         """Enqueue one request. Never blocks: overload and post-close
-        submits come back as exceptions on the returned future."""
+        submits come back as exceptions on the returned future.
+        Brownout degradation applies here, at the admission door: under
+        queue pressure or recent faults the request is downgraded (NFE
+        cap, forced cache plan) instead of shed — the effective request
+        determines grouping, and the result carries the flags."""
         fut = ServingFuture()
         tel = self.telemetry
         with self._cv:
@@ -210,7 +277,16 @@ class ServingScheduler:
                 fut.set_exception(DeadlineExceeded(
                     f"queue full ({self.config.max_queue})"))
                 return fut
-            self._queue.append((req, fut, t_sub, tr))
+            req_eff, flags = req, ()
+            if self.brownout is not None:
+                tier = self.brownout.tier(len(self._queue),
+                                          self.config.max_queue, t_sub)
+                req_eff, flags = self.brownout.apply(req, tier)
+                if flags:
+                    self.tracer.note(tr, "brownout", t_sub, tier=tier,
+                                     flags=list(flags))
+            self._queue.append(_Pending(req_eff, fut, t_sub, tr,
+                                        orig_req=req, degraded=flags))
             tel.gauge("serving/queue_depth").set(len(self._queue))
             self._cv.notify_all()
         return fut
@@ -223,23 +299,46 @@ class ServingScheduler:
             return
         now = _now()
         kept: Deque = deque()
-        for req, fut, t_sub, tr in self._queue:
-            if req.deadline_s is not None and now - t_sub > req.deadline_s:
+        for e in self._queue:
+            if e.req.deadline_s is not None \
+                    and now - e.t_sub > e.req.deadline_s:
                 self.telemetry.counter("serving/shed").inc()
-                self.tracer.shed(tr, "deadline", now)
-                fut.set_exception(DeadlineExceeded(
-                    f"deadline {req.deadline_s}s passed while queued"))
+                self.tracer.shed(e.trace, "deadline", now)
+                e.fut.set_exception(DeadlineExceeded(
+                    f"deadline {e.req.deadline_s}s passed while queued"))
             else:
-                kept.append((req, fut, t_sub, tr))
+                kept.append(e)
         self._queue = kept
         self.telemetry.gauge("serving/queue_depth").set(len(self._queue))
+
+    def _shed_expired_active(self, rows: List[RequestState],
+                             now: float) -> List[RequestState]:
+        """Mid-flight deadline check at the round boundary: a request
+        whose deadline passed BETWEEN rounds is shed before the next
+        round spends more compute on it (its sunk rounds are lost, but
+        nobody is waiting for the result anymore). Counted at
+        `serving/shed` + `serving/shed_midflight`; the trace row closes
+        with `outcome=shed:deadline`."""
+        kept: List[RequestState] = []
+        for r in rows:
+            if r.req.deadline_s is not None \
+                    and now - r.submit_t > r.req.deadline_s:
+                self.telemetry.counter("serving/shed").inc()
+                self.telemetry.counter("serving/shed_midflight").inc()
+                self.tracer.shed(r.trace, "deadline", now)
+                r.future.set_exception(DeadlineExceeded(
+                    f"deadline {r.req.deadline_s}s passed mid-flight "
+                    f"after {r.rounds} round(s)"))
+            else:
+                kept.append(r)
+        return kept
 
     def _pick_group_locked(self) -> Optional[tuple]:
         """Least-recently-served group among those with work (active
         rows or queued requests), queue order breaking ties."""
         candidates: List[tuple] = list(self._active.keys())
-        for req, _, _, _ in self._queue:
-            gk = self.engine.group_key(req)
+        for e in self._queue:
+            gk = self.engine.group_key(e.req)
             if gk not in candidates:
                 candidates.append(gk)
         if not candidates:
@@ -250,30 +349,263 @@ class ServingScheduler:
     def _admit_locked(self, gk: tuple, capacity: int,
                       now: float) -> List[RequestState]:
         """Pop up to `capacity` queued requests of group `gk` (FIFO) and
-        prepare their device carries."""
+        prepare their device carries. Requeued entries still inside
+        their retry backoff window (`not_before`) are skipped."""
         admitted: List[RequestState] = []
         kept: Deque = deque()
-        for req, fut, t_sub, tr in self._queue:
-            if len(admitted) < capacity \
-                    and self.engine.group_key(req) == gk:
+        for e in self._queue:
+            if len(admitted) < capacity and e.not_before <= now \
+                    and self.engine.group_key(e.req) == gk:
                 try:
-                    st = self.engine.prepare(req, fut, t_sub, now)
-                    st.trace = tr
+                    st = self.engine.prepare(e.req, e.fut, e.t_sub, now)
+                    st.trace = e.trace
+                    st.attempts = e.attempts
+                    st.orig_req = e.orig_req or e.req
+                    st.degraded = e.degraded
                     admitted.append(st)
-                except Exception as e:  # bad request, not a loop error
+                except Exception as exc:  # bad request, not a loop error
                     self.tracer.shed(
-                        tr, f"prepare_error:{type(e).__name__}", _now())
-                    fut.set_exception(e)
+                        e.trace, f"prepare_error:{type(exc).__name__}",
+                        _now())
+                    e.fut.set_exception(exc)
             else:
-                kept.append((req, fut, t_sub, tr))
+                kept.append(e)
         self._queue = kept
         self.telemetry.gauge("serving/queue_depth").set(len(self._queue))
         return admitted
 
+    # -- fault isolation ------------------------------------------------------
+    def _checked_advance(self, rows: List[RequestState], bucket: int,
+                         round_steps: int):
+        """One engine round behind the serving fault barriers
+        (resilience/faults.py): `serving.device_lost` (flag -> raises
+        `DeviceLost`) models a dead chip, `serving.round` is polled
+        once per row with `key="seed:<seed>:"` so a per-key plan can
+        deterministically poison ONE request no matter what it is
+        batched with. One dict lookup each with no plan armed."""
+        if _faults.check("serving.device_lost"):
+            raise DeviceLost("injected fault at serving.device_lost")
+        for r in rows:
+            _faults.check("serving.round", key=f"seed:{r.req.seed}:")
+        return self.engine.advance(rows, bucket, round_steps)
+
+    def _fail_state(self, r: RequestState, fault: ServingFault,
+                    outcome: str) -> None:
+        """Resolve one in-flight request's future with a typed fault
+        and close its trace row with the fault outcome."""
+        self.tracer.fail(r, outcome, _now())
+        r.future.set_exception(fault)
+
+    def _requeue_locked(self, states: List[RequestState], now: float,
+                        cause: Optional[BaseException] = None,
+                        penalize: bool = True) -> None:
+        """Re-enter failed-but-innocent requests into the queue for a
+        bit-exact replay from scratch (`SampleRequest` carries seed,
+        NFE, and cache plan — `prepare` reconstructs the whole carry).
+        With `penalize`, the attempt counts against the bounded retry
+        budget and the re-dispatch waits out the policy's backoff;
+        rebuild interruptions requeue unpenalized (the device fault was
+        not theirs). Held lock."""
+        retry = self.config.retry
+        delays = retry.delays()
+        for r in states:
+            attempts = r.attempts + (1 if penalize else 0)
+            if penalize and attempts >= retry.max_attempts:
+                self.telemetry.counter("serving/retries_exhausted").inc()
+                self._fail_state(r, ServingFault(
+                    f"gave up after {attempts} attempt(s): {cause!r}",
+                    kind="retries_exhausted", request=r.orig_req,
+                    attempts=attempts, cause=cause),
+                    "fault:retries_exhausted")
+                continue
+            delay = 0.0
+            if penalize and delays:
+                delay = delays[min(attempts - 1, len(delays) - 1)]
+            self.telemetry.counter("serving/requeued").inc()
+            self.tracer.note(r.trace, "requeued", now,
+                             attempts=attempts,
+                             backoff_s=round(delay, 3))
+            self._queue.append(_Pending(
+                r.orig_req or r.req, r.future, r.submit_t, r.trace,
+                attempts=attempts, orig_req=r.orig_req,
+                not_before=now + delay, degraded=r.degraded))
+        self.telemetry.gauge("serving/queue_depth").set(len(self._queue))
+
+    def _convict(self, rows: List[RequestState], buckets: Tuple[int, ...],
+                 round_steps: int):
+        """Binary-search eviction after a batch fault: requests are
+        deterministic given their seed, so any suspect row can be
+        re-run solo from scratch to convict. Probes re-prepare fresh
+        carries (the failed round may have poisoned the old ones) and
+        run ONE round through the same fault barriers; a subset that
+        passes is innocent wholesale, a failing singleton is convicted.
+        A transient fault that does not reproduce convicts nobody.
+        Returns (guilty, innocent). `DeviceLost` during a probe
+        propagates — the caller re-routes to the rebuild path."""
+
+        def probe(subset) -> Optional[BaseException]:
+            self.telemetry.counter("serving/probe_rounds").inc()
+            try:
+                sts = [self.engine.prepare(r.req, ServingFuture(),
+                                           r.submit_t, _now())
+                       for r in subset]
+                self._checked_advance(
+                    sts, bucket_up(len(sts), buckets), round_steps)
+                return None
+            except (KeyboardInterrupt, SystemExit, DeviceLost):
+                raise
+            except BaseException as e:  # noqa: BLE001 — verdict, not flow
+                return e
+
+        def search(subset):
+            if probe(subset) is None:
+                return [], list(subset)
+            if len(subset) == 1:
+                return list(subset), []
+            mid = len(subset) // 2
+            g1, i1 = search(subset[:mid])
+            g2, i2 = search(subset[mid:])
+            if not g1 and not g2:
+                # halves pass solo but the whole failed together:
+                # transient — nobody convicted, everyone requeues
+                return [], list(subset)
+            return g1 + g2, i1 + i2
+
+        if len(rows) == 1:
+            return search(list(rows))
+        # the full batch ALREADY failed — go straight to the halves; a
+        # one-shot transient then passes both and convicts nobody
+        mid = len(rows) // 2
+        g1, i1 = search(list(rows[:mid]))
+        g2, i2 = search(list(rows[mid:]))
+        if not g1 and not g2:
+            return [], list(rows)
+        return g1 + g2, i1 + i2
+
+    def _on_round_failure(self, gk: tuple, rows: List[RequestState],
+                          exc: BaseException, buckets: Tuple[int, ...],
+                          round_steps: int) -> None:
+        """Fault-isolate one failed round: classify, convict or
+        rebuild, requeue the innocent. The failing round poisons only
+        its own group — other groups' active rows are untouched (except
+        under device loss, where every carry references a dead
+        device)."""
+        kind = classify(exc)
+        now = _now()
+        self.telemetry.counter("serving/round_faults").inc()
+        record_event("serving_fault", "serving.round",
+                     detail=f"{kind}: {exc!r} rows={len(rows)}")
+        if self.brownout is not None:
+            self.brownout.note_fault(now)
+        for r in rows:
+            self.tracer.note(r.trace, "round_fault", now,
+                             fault_kind=kind,
+                             error=type(exc).__name__)
+        if kind == "device_lost":
+            self._supervised_rebuild(exc, rows)
+            return
+        try:
+            guilty, innocent = self._convict(rows, buckets, round_steps)
+        except DeviceLost as e2:
+            self._supervised_rebuild(e2, rows)
+            return
+        for r in guilty:
+            self.telemetry.counter("serving/quarantined").inc()
+            self.tracer.note(r.trace, "quarantined", _now())
+            self._fail_state(r, ServingFault(
+                f"request convicted by solo re-run after a batch "
+                f"fault: {exc!r}", kind="poisoned", request=r.orig_req,
+                attempts=r.attempts + 1, cause=exc), "fault:poisoned")
+        with self._cv:
+            self._requeue_locked(innocent, now, cause=exc)
+            self._cv.notify_all()
+
+    def _supervised_rebuild(self, exc: BaseException,
+                            rows: List[RequestState]) -> None:
+        """Device-level failure: drain in-flight completions, tear down
+        the program cache with the dead engine, rebuild on the
+        surviving device set, re-run prewarm, and requeue every
+        interrupted request (unpenalized — the fault was not theirs).
+        Without an `engine_factory` the interrupted futures fail typed
+        instead of hanging."""
+        tel = self.telemetry
+        tel.counter("serving/device_lost").inc()
+        record_event("serving_fault", "serving.device_lost",
+                     detail=repr(exc))
+        if self.brownout is not None:
+            self.brownout.note_fault(_now())
+        t0 = _now()
+        with self._cv:
+            interrupted = list(rows)
+            for rs in self._active.values():
+                interrupted.extend(rs)
+            self._active.clear()
+            # DRAINING: let the completion thread settle (or fail and
+            # requeue) every batch already handed to it before the old
+            # engine is torn down
+            self.supervisor.set_state(DRAINING)
+            while self._completions or self._processing:
+                self._cv.wait(0.05)
+        for r in interrupted:
+            self.tracer.note(r.trace, "rebuild_interrupt", _now())
+        if self.engine_factory is None:
+            for r in interrupted:
+                self._fail_state(r, ServingFault(
+                    f"device lost and no engine_factory to rebuild: "
+                    f"{exc!r}", kind="device_lost", request=r.orig_req,
+                    attempts=r.attempts, cause=exc),
+                    "fault:device_lost")
+            self.supervisor.set_state(SERVING)
+            return
+        self.engine = self.supervisor.rebuild(
+            self.engine_factory, exc, prewarm_args=self._prewarm_args)
+        self.tracer.rebuild(t0, _now(), {
+            "reason": type(exc).__name__,
+            "interrupted": len(interrupted),
+            "prewarmed": bool(self._prewarm_args)})
+        with self._cv:
+            self._requeue_locked(interrupted, _now(), cause=exc,
+                                 penalize=False)
+            self._cv.notify_all()
+
+    def _fail_all_pending(self, fault: ServingFault) -> None:
+        """Last-resort sweep when a scheduler thread dies: every
+        queued and in-flight future resolves (first set wins, so a
+        result the completion thread is delivering concurrently is
+        never clobbered)."""
+        with self._cv:
+            self._closed = True
+            for e in self._queue:
+                e.fut.set_exception(fault)
+            self._queue.clear()
+            for rows in self._active.values():
+                for r in rows:
+                    self._fail_state(r, fault, f"fault:{fault.kind}")
+            self._active.clear()
+            for rows, _, _ in self._completions:
+                for r in rows:
+                    self._fail_state(r, fault, f"fault:{fault.kind}")
+            self._completions.clear()
+            self._cv.notify_all()
+
     def _dispatch_loop(self) -> None:
+        """Crash guard around the real loop: a dying dispatch thread
+        must fail every pending future typed, never strand them
+        (regression-tested)."""
+        try:
+            self._dispatch_rounds()
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:  # noqa: BLE001 — last-resort guard
+            record_event("serving_fault", "serving.dispatch",
+                         detail=f"dispatch thread died: {e!r}")
+            self._fail_all_pending(ServingFault(
+                f"dispatch thread died: {e!r}", kind="scheduler_died",
+                cause=e))
+
+    def _dispatch_rounds(self) -> None:
         tel = self.telemetry
         cfg = self.config
-        max_bucket = max(cfg.batch_buckets)
         while True:
             with self._cv:
                 while not (self._queue or self._active or self._closed):
@@ -283,18 +615,45 @@ class ServingScheduler:
                 self._shed_expired_locked()
                 gk = self._pick_group_locked()
                 if gk is None:
-                    if self._closed:
+                    if self._closed and not self._completions \
+                            and not self._processing:
+                        # a draining close may still see a fetch-fault
+                        # requeue from the completion thread — only
+                        # exit once nothing in flight can re-enter
                         break
+                    self._cv.wait(0.02)
                     continue
-                rows = self._active.pop(gk, [])
                 now = _now()
-                rows += self._admit_locked(gk, max_bucket - len(rows), now)
+                # brownout tier 3: shrink rounds to the smallest bucket
+                # (smaller blast radius + memory footprint) before any
+                # shedding happens
+                tier = (self.brownout.tier(len(self._queue),
+                                           cfg.max_queue, now)
+                        if self.brownout is not None else 0)
+                buckets = cfg.batch_buckets
+                if tier >= 3:
+                    buckets = (min(cfg.batch_buckets),)
+                max_bucket = max(buckets)
+                rows = self._shed_expired_active(
+                    self._active.pop(gk, []), now)
+                if len(rows) > max_bucket:
+                    # bucket shrink mid-group: overflow rows stay
+                    # active and ride the group's next round
+                    self._active[gk] = rows[max_bucket:]
+                    rows = rows[:max_bucket]
+                rows += self._admit_locked(gk, max_bucket - len(rows),
+                                           now)
                 if not rows:
+                    # group had only backoff-parked entries (or every
+                    # row was shed): wait for the earliest retry
+                    self._cv.wait(0.02)
                     continue
+                if tier >= 3:
+                    tel.counter("serving/brownout_bucket_shrunk").inc()
                 self._round_no += 1
                 self._last_served[gk] = self._round_no
 
-            bucket = bucket_up(len(rows), cfg.batch_buckets)
+            bucket = bucket_up(len(rows), buckets)
             round_steps = cfg.round_steps or nfe_bucket(
                 max(r.remaining for r in rows))
             tel.gauge("serving/batch_occupancy").set(len(rows) / bucket)
@@ -306,23 +665,36 @@ class ServingScheduler:
                 if r.first_dispatch_t is None:
                     r.first_dispatch_t = t_disp
 
-            finished, _ = self.engine.advance(rows, bucket, round_steps)
-            if self.tracer.enabled:
-                # host timestamps + host-side dicts only: tracing must
-                # not add a single device sync to the dispatch loop
-                self.tracer.round(
-                    rows, getattr(self.engine, "last_round_info", None),
-                    t_disp, _now(), self._round_no)
-            live = [r for r in rows if r.remaining > 0]
-            if finished:
-                t_fin = _now()
-                out, _ = self.engine.finalize(
-                    finished, bucket_up(len(finished), cfg.batch_buckets))
+            try:
+                finished, _ = self._checked_advance(rows, bucket,
+                                                    round_steps)
                 if self.tracer.enabled:
-                    self.tracer.finalize(
-                        finished,
-                        getattr(self.engine, "last_finalize_info", None),
-                        t_fin, _now())
+                    # host timestamps + host-side dicts only: tracing
+                    # must not add a single device sync to the
+                    # dispatch loop
+                    self.tracer.round(
+                        rows,
+                        getattr(self.engine, "last_round_info", None),
+                        t_disp, _now(), self._round_no)
+                live = [r for r in rows if r.remaining > 0]
+                if finished:
+                    t_fin = _now()
+                    out, _ = self.engine.finalize(
+                        finished, bucket_up(len(finished), buckets))
+                    if self.tracer.enabled:
+                        self.tracer.finalize(
+                            finished,
+                            getattr(self.engine,
+                                    "last_finalize_info", None),
+                            t_fin, _now())
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — fault barrier
+                # the failing round poisons only its group: convict /
+                # requeue / rebuild, then keep serving everyone else
+                self._on_round_failure(gk, rows, e, buckets,
+                                       round_steps)
+                continue
             with self._cv:
                 if live:
                     self._active.setdefault(gk, []).extend(live)
@@ -343,12 +715,27 @@ class ServingScheduler:
                     r.future.set_exception(
                         SchedulerClosed("scheduler closed"))
             self._active.clear()
-            for _, fut, _, _ in self._queue:
-                fut.set_exception(SchedulerClosed("scheduler closed"))
+            for e in self._queue:
+                e.fut.set_exception(SchedulerClosed("scheduler closed"))
             self._queue.clear()
 
     # -- completion loop ------------------------------------------------------
     def _completion_loop(self) -> None:
+        """Crash guard around the real loop (mirrors the dispatch
+        guard): a dying completion thread fails every pending future
+        typed and unblocks the dispatch loop's backpressure wait."""
+        try:
+            self._completion_rounds()
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:  # noqa: BLE001 — last-resort guard
+            record_event("serving_fault", "serving.complete",
+                         detail=f"completion thread died: {e!r}")
+            self._fail_all_pending(ServingFault(
+                f"completion thread died: {e!r}", kind="scheduler_died",
+                cause=e))
+
+    def _completion_rounds(self) -> None:
         tel = self.telemetry
 
         def hist(name: str):
@@ -361,9 +748,42 @@ class ServingScheduler:
                 if not self._completions and self._dispatch_done:
                     break
                 rows, out, _t_disp = self._completions.popleft()
+                self._processing = True
                 self._cv.notify_all()     # free a backpressure slot
-            _block_until_ready(out)
-            host = _device_get(out)
+            try:
+                # serving.fetch fault barrier: a failed readback is a
+                # fault of the FETCH, not of any request — the batch
+                # requeues for a bit-exact replay from scratch
+                _faults.check("serving.fetch")
+                _block_until_ready(out)
+                host = _device_get(out)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — fault barrier
+                tel.counter("serving/fetch_faults").inc()
+                record_event("serving_fault", "serving.fetch",
+                             detail=repr(e))
+                now = _now()
+                if self.brownout is not None:
+                    self.brownout.note_fault(now)
+                for r in rows:
+                    self.tracer.note(r.trace, "fetch_fault", now,
+                                     error=type(e).__name__)
+                with self._cv:
+                    if self._dispatch_done:
+                        # nothing left to serve a requeue — fail typed
+                        for r in rows:
+                            self._fail_state(r, ServingFault(
+                                f"completion fetch failed after "
+                                f"dispatch ended: {e!r}",
+                                kind="fetch_error", request=r.orig_req,
+                                attempts=r.attempts, cause=e),
+                                "fault:fetch_error")
+                    else:
+                        self._requeue_locked(rows, now, cause=e)
+                    self._processing = False
+                    self._cv.notify_all()
+                continue
             t_ready = _now()
             for i, r in enumerate(rows):
                 latency_ms = (t_ready - r.submit_t) * 1e3
@@ -383,4 +803,8 @@ class ServingScheduler:
                 r.future.set_result(SampleResult(
                     samples=host[i], request=r.req, queue_ms=queue_ms,
                     compile_ms=r.compile_ms, device_ms=device_ms,
-                    latency_ms=latency_ms, rounds=r.rounds))
+                    latency_ms=latency_ms, rounds=r.rounds,
+                    attempts=r.attempts, degraded=r.degraded))
+            with self._cv:
+                self._processing = False
+                self._cv.notify_all()

@@ -52,7 +52,7 @@ class RequestTrace:
     dicts appended by the dispatch thread, emitted once at completion)."""
 
     __slots__ = ("trace_id", "seq", "submit_s", "summary", "rounds",
-                 "outcome")
+                 "outcome", "events")
 
     def __init__(self, trace_id: str, seq: int, submit_s: float,
                  summary: Dict[str, Any]):
@@ -61,6 +61,10 @@ class RequestTrace:
         self.submit_s = submit_s
         self.summary = summary
         self.rounds: List[Dict[str, Any]] = []
+        # recovery events (round_fault/requeued/quarantined/rebuild/
+        # brownout, serving/supervision.py) — kept separate from
+        # `rounds` so round_detail still counts dispatched rounds 1:1
+        self.events: List[Dict[str, Any]] = []
         self.outcome: Optional[str] = None
 
     @property
@@ -125,6 +129,51 @@ class RequestTracer:
             "outcome": tr.outcome,
             "queue_ms": (at_s - tr.submit_s) * 1e3, **tr.summary})
 
+    def note(self, tr: Optional[RequestTrace], kind: str, at_s: float,
+             **args) -> None:
+        """Attach one recovery event (retry/quarantine/brownout/
+        rebuild-interrupt, serving/supervision.py) to a request's
+        trace: an instant on the request's lane plus a row in the
+        trace's `recovery` list, so every recovery step is attributable
+        in the drill-down."""
+        if tr is None or not self.enabled:
+            return
+        tr.events.append({"event": kind, **args})
+        self.telemetry.recorder.instant_at(
+            f"req.{kind}", at_s, cat="serving",
+            args={"trace_id": tr.trace_id, **args}, tid=tr.tid)
+
+    def fail(self, state, outcome: str, at_s: float) -> None:
+        """A request resolved with a typed fault (ServingFault): close
+        its trace with the fault outcome, same row shape as `shed` but
+        carrying the attempt count and recovery events."""
+        tr = getattr(state, "trace", None)
+        if tr is None or not self.enabled:
+            return
+        tr.outcome = outcome
+        rec = self.telemetry.recorder
+        rec.event_at("req.queue", tr.submit_s, at_s, cat="serving",
+                     args={"trace_id": tr.trace_id,
+                           "outcome": outcome}, tid=tr.tid)
+        row = {"type": "request_trace", "trace_id": tr.trace_id,
+               "outcome": outcome,
+               "queue_ms": (at_s - tr.submit_s) * 1e3,
+               "attempts": int(getattr(state, "attempts", 0)),
+               **tr.summary}
+        if tr.events:
+            row["recovery"] = list(tr.events)
+        self.telemetry.write_record(row)
+
+    def rebuild(self, t0_s: float, t1_s: float,
+                args: Optional[Dict[str, Any]] = None) -> None:
+        """Engine supervision span on the dispatch lane: drain +
+        rebuild + prewarm after device loss."""
+        if not self.enabled:
+            return
+        self.telemetry.recorder.event_at(
+            "serve.rebuild", t0_s, t1_s, cat="serving",
+            args=args or {}, tid=DISPATCH_TID)
+
     # -- dispatch-side spans (dispatch thread; host timestamps only) --------
     def round(self, rows, info: Optional[Dict[str, Any]], t0: float,
               t1: float, round_no: int) -> None:
@@ -181,10 +230,21 @@ class RequestTracer:
                            "compile_ms": round(compile_ms, 3),
                            "device_ms": round(device_ms, 3),
                            "rounds": int(state.rounds)}, tid=tr.tid)
-        self.telemetry.write_record({
+        row = {
             "type": "request_trace", "trace_id": tr.trace_id,
             "outcome": "ok",
             "queue_ms": queue_ms, "compile_ms": compile_ms,
             "device_ms": device_ms, "latency_ms": latency_ms,
             "rounds": int(state.rounds),
-            "round_detail": list(tr.rounds), **tr.summary})
+            "round_detail": list(tr.rounds), **tr.summary}
+        # recovery provenance (serving/supervision.py): retried or
+        # degraded completions say so in their own row
+        attempts = int(getattr(state, "attempts", 0))
+        if attempts:
+            row["attempts"] = attempts
+        degraded = tuple(getattr(state, "degraded", ()) or ())
+        if degraded:
+            row["degraded"] = list(degraded)
+        if tr.events:
+            row["recovery"] = list(tr.events)
+        self.telemetry.write_record(row)

@@ -187,6 +187,56 @@ def test_queue_full_sheds_at_the_door():
     assert sched.telemetry.registry.counter("serving/shed").value == 1
 
 
+def test_midflight_deadline_shed_at_round_boundary():
+    """A request whose deadline passes BETWEEN rounds is shed at the
+    next round boundary (not only at dispatch admission), with
+    `serving/shed_midflight` counting it and the future resolving
+    `DeadlineExceeded` — no more compute is spent on it."""
+    eng = FakeEngine(step_delay_s=0.03)
+    tel = Telemetry(enabled=False)
+    sched = ServingScheduler(
+        engine=eng, telemetry=tel, autostart=False,
+        config=SchedulerConfig(round_steps=1, batch_buckets=(1, 2)))
+    # 8 rounds x 30 ms but a 50 ms budget: admitted (deadline alive at
+    # dispatch), then expires mid-flight
+    doomed = sched.submit(SampleRequest(resolution=8, diffusion_steps=8,
+                                        sampler="ddim", deadline_s=0.05))
+    ok = sched.submit(SampleRequest(resolution=8, diffusion_steps=8,
+                                    sampler="ddim", seed=9))
+    sched.start()
+    assert np.all(ok.result(timeout=20).samples == 9.0)
+    with pytest.raises(DeadlineExceeded, match="mid-flight"):
+        doomed.result(timeout=20)
+    sched.close()
+    snap = tel.registry.snapshot()
+    assert snap["serving/shed_midflight"] == 1
+    assert snap["serving/shed"] == 1
+    # it WAS admitted (this is the mid-flight case, not queue shedding)
+    assert any(r.deadline_s is not None for r in eng.prepared)
+
+
+def test_dispatch_thread_death_fails_all_futures(monkeypatch):
+    """Regression for the stranded-future bug class: if the dispatch
+    thread dies, every queued/in-flight future must resolve with a
+    typed ServingFault, and later submits are refused — nobody waits
+    forever."""
+    from flaxdiff_tpu.serving import ServingFault
+    eng, sched = _fake_scheduler()
+    futs = [sched.submit(SampleRequest(resolution=8, diffusion_steps=4,
+                                       seed=i)) for i in range(3)]
+    monkeypatch.setattr(
+        sched, "_pick_group_locked",
+        lambda: (_ for _ in ()).throw(RuntimeError("scheduler bug")))
+    sched.start()
+    for f in futs:
+        with pytest.raises(ServingFault) as ei:
+            f.result(timeout=10)
+        assert ei.value.kind == "scheduler_died"
+    with pytest.raises(SchedulerClosed):
+        sched.submit(SampleRequest(resolution=8)).result(timeout=5)
+    sched.close(drain=False)
+
+
 def test_submit_after_close_and_drain():
     eng, sched = _fake_scheduler()
     futs = [sched.submit(SampleRequest(resolution=8, diffusion_steps=4,

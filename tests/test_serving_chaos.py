@@ -1,0 +1,413 @@
+"""Serving resilience chaos suite (ISSUE 15, docs/SERVING.md
+"Failure semantics").
+
+Acceptance bars, enforced here end to end:
+- under injected round / fetch / device faults, ZERO futures are ever
+  stranded — every one resolves with a result, `DeadlineExceeded`,
+  `SchedulerClosed`, or a typed `ServingFault`;
+- retried completions are bit-identical to fault-free solo
+  `generate_samples` runs (deterministic replay from the request's
+  seed);
+- a rebuilt engine serves prewarmed traffic with zero re-traces;
+- the healthy path performs the IDENTICAL seam-counted host syncs as
+  before supervision existed (counting mock).
+
+Scheduler mechanics run against the jax-free FakeEngine pattern from
+tests/test_serving.py; the bit-identity and rebuild-prewarm bars run
+against a real tiny pipeline.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from flaxdiff_tpu import resilience as R
+from flaxdiff_tpu.serving import (BrownoutConfig, DeviceLost,
+                                  SampleRequest, SchedulerConfig,
+                                  ServingFault, ServingScheduler,
+                                  classify)
+from flaxdiff_tpu.serving import scheduler as sched_mod
+from flaxdiff_tpu.telemetry import Telemetry
+from tests.test_serving import FakeEngine
+
+pytestmark = pytest.mark.chaos
+
+
+def _sched(tel=None, engine=None, engine_factory=None, **cfg_kwargs):
+    eng = engine or FakeEngine()
+    tel = tel or Telemetry(enabled=False)
+    cfg_kwargs = {"round_steps": 16, "batch_buckets": (4,),
+                  **cfg_kwargs}
+    cfg = SchedulerConfig(**cfg_kwargs)
+    return eng, ServingScheduler(engine=eng, config=cfg, telemetry=tel,
+                                 autostart=False,
+                                 engine_factory=engine_factory)
+
+
+def _reqs(n, nfe=4, base_seed=100):
+    return [SampleRequest(resolution=8, diffusion_steps=nfe,
+                          sampler="ddim", seed=base_seed + i)
+            for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# taxonomy
+# ---------------------------------------------------------------------------
+
+def test_classify_taxonomy():
+    assert classify(DeviceLost("chip gone")) == "device_lost"
+    assert classify(R.InjectedFault("io blip")) == "transient"
+    assert classify(OSError("reset")) == "transient"
+    assert classify(ValueError("bad shape")) == "fatal"
+    assert classify(R.InjectedHTTPError(404)) == "fatal"
+
+
+# ---------------------------------------------------------------------------
+# round faults: transient retry, poisoned-row conviction, exhaustion
+# ---------------------------------------------------------------------------
+
+def test_transient_round_fault_retries_all(tmp_path):
+    """A one-shot round fault convicts nobody: the whole batch
+    requeues with bounded attempts and completes bit-identically;
+    the trace rows attribute the recovery."""
+    import json
+    tel = Telemetry.create(str(tmp_path))
+    eng, sched = _sched(tel)
+    reqs = _reqs(4)
+    plan = R.FaultPlan([R.FaultSpec("serving.round", at=(1,), times=1)],
+                       seed=0)
+    with plan.installed():
+        futs = [sched.submit(r) for r in reqs]
+        sched.start()
+        outs = [f.result(timeout=20) for f in futs]
+        sched.close()
+    for r, o in zip(reqs, outs):
+        assert np.all(o.samples == float(r.seed))
+        assert o.attempts == 1          # one failed round, one replay
+    snap = tel.registry.snapshot()
+    assert snap["serving/round_faults"] == 1
+    assert snap["serving/requeued"] == 4
+    assert snap.get("serving/quarantined", 0) == 0
+    # binary search probed both halves, neither reproduced the fault
+    assert snap["serving/probe_rounds"] == 2
+    tel.close()
+    recs = [json.loads(line) for line in
+            (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+    traces = [r for r in recs if r.get("type") == "request_trace"]
+    assert len(traces) == 4
+    for t in traces:
+        assert t["outcome"] == "ok" and t["attempts"] == 1
+        kinds = [e["event"] for e in t["recovery"]]
+        assert kinds == ["round_fault", "requeued"]
+
+
+def test_poisoned_request_quarantined_others_complete():
+    """A deterministically failing request is convicted by the
+    binary-search solo re-run and fails typed; its round-mates are
+    innocent and complete."""
+    tel = Telemetry(enabled=False)
+    eng, sched = _sched(tel)
+    reqs = _reqs(4, base_seed=5)        # seeds 5, 6, 7, 8
+    plan = R.FaultPlan([R.FaultSpec("serving.round", per_key=True,
+                                    match="seed:7:", prob=1.0)], seed=0)
+    with plan.installed():
+        futs = [sched.submit(r) for r in reqs]
+        sched.start()
+        results = {}
+        for r, f in zip(reqs, futs):
+            try:
+                results[r.seed] = f.result(timeout=20)
+            except ServingFault as e:
+                results[r.seed] = e
+        sched.close()
+    assert isinstance(results[7], ServingFault)
+    assert results[7].kind == "poisoned"
+    for seed in (5, 6, 8):
+        assert np.all(results[seed].samples == float(seed))
+    snap = tel.registry.snapshot()
+    assert snap["serving/quarantined"] == 1
+    assert snap["serving/requeued"] == 3
+
+
+def test_fetch_fault_retries_then_exhausts():
+    """Completion-fetch faults requeue the batch; a persistent one
+    burns the bounded budget and fails typed — never a hang."""
+    tel = Telemetry(enabled=False)
+    eng, sched = _sched(tel)
+    plan = R.FaultPlan([R.FaultSpec("serving.fetch",
+                                    at=tuple(range(1, 50)))], seed=0)
+    with plan.installed():
+        fut = sched.submit(_reqs(1)[0])
+        sched.start()
+        with pytest.raises(ServingFault) as ei:
+            fut.result(timeout=20)
+        sched.close()
+    assert ei.value.kind == "retries_exhausted"
+    assert ei.value.attempts == 3       # default RetryPolicy budget
+    snap = tel.registry.snapshot()
+    assert snap["serving/fetch_faults"] == 3
+    assert snap["serving/retries_exhausted"] == 1
+    assert snap["serving/requeued"] == 2
+
+
+def test_fetch_fault_transient_recovers():
+    tel = Telemetry(enabled=False)
+    eng, sched = _sched(tel)
+    plan = R.FaultPlan([R.FaultSpec("serving.fetch", at=(1,), times=1)],
+                       seed=0)
+    with plan.installed():
+        futs = [sched.submit(r) for r in _reqs(2)]
+        sched.start()
+        outs = [f.result(timeout=20) for f in futs]
+        sched.close()
+    assert all(o.attempts == 1 for o in outs)
+    snap = tel.registry.snapshot()
+    assert snap["serving/fetch_faults"] == 1
+    assert snap["serving/requests_ok"] == 2
+
+
+# ---------------------------------------------------------------------------
+# device loss: supervised rebuild
+# ---------------------------------------------------------------------------
+
+def test_device_lost_rebuilds_engine_and_requeues():
+    tel = Telemetry(enabled=False)
+    e1 = FakeEngine()
+    rebuilt = []
+
+    def factory():
+        e = FakeEngine()
+        rebuilt.append(e)
+        return e
+
+    eng, sched = _sched(tel, engine=e1, engine_factory=factory)
+    plan = R.FaultPlan([R.FaultSpec("serving.device_lost", at=(1,),
+                                    times=1, error="flag")], seed=0)
+    reqs = _reqs(3)
+    with plan.installed():
+        futs = [sched.submit(r) for r in reqs]
+        sched.start()
+        outs = [f.result(timeout=20) for f in futs]
+        sched.close()
+    assert rebuilt and sched.engine is rebuilt[-1]
+    for r, o in zip(reqs, outs):
+        assert np.all(o.samples == float(r.seed))
+        assert o.attempts == 0          # rebuild requeue is unpenalized
+    snap = tel.registry.snapshot()
+    assert snap["serving/device_lost"] == 1
+    assert snap["serving/supervisor_rebuilds"] == 1
+    assert snap["serving/supervisor_state"] == 0      # back to SERVING
+
+
+def test_device_lost_without_factory_fails_typed():
+    tel = Telemetry(enabled=False)
+    eng, sched = _sched(tel)            # explicit engine, no factory
+    plan = R.FaultPlan([R.FaultSpec("serving.device_lost", at=(1,),
+                                    times=1, error="flag")], seed=0)
+    with plan.installed():
+        futs = [sched.submit(r) for r in _reqs(2)]
+        sched.start()
+        for f in futs:
+            with pytest.raises(ServingFault) as ei:
+                f.result(timeout=20)
+            assert ei.value.kind == "device_lost"
+        sched.close()
+    assert tel.registry.snapshot().get("serving/supervisor_rebuilds",
+                                       0) == 0
+
+
+# ---------------------------------------------------------------------------
+# brownout degradation
+# ---------------------------------------------------------------------------
+
+def test_brownout_caps_nfe_under_queue_pressure():
+    tel = Telemetry(enabled=False)
+    eng, sched = _sched(
+        tel, max_queue=10,
+        brownout=BrownoutConfig(queue_soft=0.2, queue_heavy=2.0,
+                                queue_critical=2.0, nfe_cap=4,
+                                force_plan=None))
+    reqs = [SampleRequest(resolution=8, diffusion_steps=16,
+                          sampler="ddim", seed=200 + i)
+            for i in range(8)]
+    futs = [sched.submit(r) for r in reqs]
+    sched.start()
+    outs = [f.result(timeout=20) for f in futs]
+    sched.close()
+    degraded = [o for o in outs if o.degraded]
+    assert degraded, "queue pressure should have degraded admissions"
+    for o in degraded:
+        assert o.degraded == ("nfe_capped",)
+        assert o.request.diffusion_steps == 4       # effective request
+    # early submits saw an empty queue and kept their full NFE
+    assert any(o.request.diffusion_steps == 16 for o in outs)
+    snap = tel.registry.snapshot()
+    assert snap["serving/brownout_requests"] == len(degraded)
+    assert snap["serving/brownout_nfe_capped"] == len(degraded)
+
+
+def test_brownout_critical_shrinks_batch_buckets():
+    tel = Telemetry(enabled=False)
+    eng, sched = _sched(
+        tel, max_queue=10, batch_buckets=(1, 2, 4),
+        brownout=BrownoutConfig(queue_soft=2.0, queue_heavy=2.0,
+                                queue_critical=0.3, nfe_cap=0,
+                                force_plan=None))
+    futs = [sched.submit(r) for r in _reqs(8)]
+    sched.start()
+    for f in futs:
+        f.result(timeout=20)
+    sched.close()
+    # the first round ran under tier 3: smallest bucket, not 4
+    assert eng.advance_calls[0][1] == 1
+    assert tel.registry.snapshot()["serving/brownout_bucket_shrunk"] >= 1
+
+
+def test_fault_raises_brownout_floor():
+    """A round fault keeps the tier at the floor for the cooldown even
+    with an empty queue — degrade while provably unhealthy."""
+    tel = Telemetry(enabled=False)
+    eng, sched = _sched(
+        tel, brownout=BrownoutConfig(nfe_cap=4, force_plan=None,
+                                     fault_cooldown_s=30.0))
+    plan = R.FaultPlan([R.FaultSpec("serving.round", at=(1,), times=1)],
+                       seed=0)
+    with plan.installed():
+        first = sched.submit(SampleRequest(resolution=8,
+                                           diffusion_steps=16,
+                                           sampler="ddim", seed=1))
+        sched.start()
+        assert first.result(timeout=20).attempts == 1
+        # submitted AFTER the fault: queue empty, but the fault floor
+        # holds tier >= 1 -> NFE capped
+        later = sched.submit(SampleRequest(resolution=8,
+                                           diffusion_steps=16,
+                                           sampler="ddim", seed=2))
+        out = later.result(timeout=20)
+        sched.close()
+    assert out.degraded == ("nfe_capped",)
+
+
+# ---------------------------------------------------------------------------
+# healthy path: sync parity with supervision active
+# ---------------------------------------------------------------------------
+
+def test_healthy_path_sync_parity(monkeypatch):
+    """Supervision, brownout, and the armed-but-empty fault plan add
+    ZERO host syncs to the healthy path: one completed batch still
+    costs exactly one block_until_ready + one device_get (the PR-5
+    counting-mock contract, unchanged from pre-supervision)."""
+    blocks, gets = [], []
+    real_block = sched_mod._block_until_ready
+    real_get = sched_mod._device_get
+    monkeypatch.setattr(sched_mod, "_block_until_ready",
+                        lambda x: (blocks.append(1), real_block(x))[1])
+    monkeypatch.setattr(sched_mod, "_device_get",
+                        lambda x: (gets.append(1), real_get(x))[1])
+    tel = Telemetry(enabled=False)
+    eng, sched = _sched(tel)
+    with R.FaultPlan([], seed=0).installed():     # armed, empty
+        futs = [sched.submit(r) for r in _reqs(3)]
+        sched.start()
+        for f in futs:
+            f.result(timeout=20)
+        sched.close()
+    assert len(blocks) == 1 and len(gets) == 1
+    snap = tel.registry.snapshot()
+    assert snap.get("serving/round_faults", 0) == 0
+    assert snap.get("serving/requeued", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# real-engine acceptance: retried bit-identity + rebuilt-warm zero retrace
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_pipe():
+    import jax
+    import jax.numpy as jnp
+
+    from flaxdiff_tpu.inference import (DiffusionInferencePipeline,
+                                        build_model)
+    config = {
+        "model": {"name": "simple_dit", "emb_features": 32,
+                  "num_heads": 4, "num_layers": 1, "patch_size": 4,
+                  "output_channels": 1},
+        "schedule": {"name": "cosine", "timesteps": 100},
+        "predictor": "epsilon",
+    }
+    model = build_model("simple_dit", emb_features=32, num_heads=4,
+                        num_layers=1, patch_size=4, output_channels=1)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 1)),
+                        jnp.zeros((1,)), None)
+    return DiffusionInferencePipeline.from_config(config, params=params)
+
+
+def _real_reqs():
+    return [SampleRequest(resolution=8, channels=1, diffusion_steps=3,
+                          sampler="ddim", seed=7, use_ema=False),
+            SampleRequest(resolution=8, channels=1, diffusion_steps=5,
+                          sampler="ddim", seed=11, use_ema=False)]
+
+
+def _assert_solo_identical(pipe, reqs, outs):
+    for r, o in zip(reqs, outs):
+        solo = pipe.generate_samples(
+            num_samples=1, resolution=8, channels=1,
+            diffusion_steps=r.diffusion_steps, sampler=r.sampler,
+            seed=r.seed, use_ema=False)
+        np.testing.assert_array_equal(o.samples, solo)
+
+
+def test_real_retried_results_bit_identical(tiny_pipe):
+    """THE retry acceptance bar: a faulted round's requests replay
+    from scratch and the retried completions are bit-identical to
+    fault-free solo runs."""
+    tel = Telemetry(enabled=False)
+    sched = ServingScheduler(
+        pipeline=tiny_pipe, telemetry=tel, autostart=False,
+        config=SchedulerConfig(round_steps=2, batch_buckets=(2,)))
+    reqs = _real_reqs()
+    plan = R.FaultPlan([R.FaultSpec("serving.round", at=(1,), times=1)],
+                       seed=0)
+    with plan.installed():
+        futs = [sched.submit(r) for r in reqs]
+        sched.start()
+        outs = [f.result(timeout=300) for f in futs]
+        sched.close()
+    assert all(o.attempts == 1 for o in outs)
+    _assert_solo_identical(tiny_pipe, reqs, outs)
+    assert tel.registry.snapshot()["serving/round_faults"] == 1
+
+
+def test_real_rebuilt_engine_serves_prewarmed_zero_retrace(tiny_pipe):
+    """THE rebuild acceptance bar: after device loss the supervisor
+    rebuilds the engine and replays prewarm, so every compile after
+    the fault happens inside the rebuild — requeued traffic adds zero
+    re-traces — and results stay bit-identical to solo runs."""
+    tel = Telemetry(enabled=False)
+    sched = ServingScheduler(
+        pipeline=tiny_pipe, telemetry=tel, autostart=False,
+        config=SchedulerConfig(round_steps=2, batch_buckets=(2,)))
+    reqs = _real_reqs()
+    sched.prewarm([reqs[0]])
+    snap0 = tel.registry.snapshot()
+    misses_prewarm = snap0["serving/program_cache_misses"]
+    prewarm_programs0 = snap0["serving/prewarm_programs"]
+
+    plan = R.FaultPlan([R.FaultSpec("serving.device_lost", at=(1,),
+                                    times=1, error="flag")], seed=0)
+    with plan.installed():
+        futs = [sched.submit(r) for r in reqs]
+        sched.start()
+        outs = [f.result(timeout=300) for f in futs]
+        sched.close()
+    _assert_solo_identical(tiny_pipe, reqs, outs)
+    snap = tel.registry.snapshot()
+    assert snap["serving/supervisor_rebuilds"] == 1
+    # every post-fault compile happened inside the rebuild's prewarm:
+    # traffic itself re-traced NOTHING
+    rebuild_prewarm = snap["serving/prewarm_programs"] - prewarm_programs0
+    assert rebuild_prewarm > 0
+    assert snap["serving/program_cache_misses"] - misses_prewarm \
+        == rebuild_prewarm
