@@ -1777,6 +1777,70 @@ def stage_serve(args) -> dict:
             f"p99={summary['recovered_p99_ms']} ms, "
             f"rebuilds={summary['rebuilds']}, "
             f"zero_stranded={res['chaos_zero_stranded']}")
+    if args.serve_pool:
+        # replicated front-door chaos (ISSUE 16): the identical
+        # workload routed through a health-checked 2-replica pool
+        # behind the FrontDoor, with a per-key serving.replica_lost
+        # fault killing r0 mid-replay. Acceptance: zero stranded
+        # futures — every request resolves (completed / shed / typed
+        # fault) even though a replica died holding traffic — and the
+        # SURVIVOR pays no re-trace for inherited traffic (every
+        # replica is prewarmed, so failed-over requests land on warm
+        # programs). Bit-identity of failed-over results vs solo runs
+        # is the per-request assertion in tests/test_frontdoor_chaos.py.
+        from flaxdiff_tpu import resilience as R
+        from flaxdiff_tpu.serving import (FrontDoor, FrontDoorConfig,
+                                          build_pool)
+        tels = [Telemetry(enabled=False) for _ in range(2)]
+        pool = build_pool(
+            [DiffusionInferencePipeline.from_config(config, params=params)
+             for _ in range(2)],
+            scheduler_config=SchedulerConfig(
+                round_steps=4, batch_buckets=(4,), max_inflight=2),
+            telemetries=tels, autostart=False)
+        door_tel = Telemetry(enabled=False)
+        door = FrontDoor(pool, telemetry=door_tel,
+                         config=FrontDoorConfig(max_attempts=3))
+        try:
+            protos, seen = [], set()
+            for _, req in workload:
+                sig = (req.diffusion_steps, req.sampler)
+                if sig not in seen:
+                    seen.add(sig)
+                    protos.append(req)
+            door.prewarm(protos)
+            for rep in pool.replicas:
+                rep.scheduler.start()
+            miss0 = tels[1].registry.snapshot().get(
+                "serving/program_cache_misses", 0.0)
+            kill_at = max(3, n // 3)
+            fault_plan = R.FaultPlan([
+                R.FaultSpec("serving.replica_lost", per_key=True,
+                            match="replica:r0:", at=(kill_at,),
+                            times=1, error="flag")], seed=0)
+            with fault_plan.installed():
+                summary = replay(door, workload,
+                                 timeout_s=600 if cpu else 120)
+        finally:
+            door.close(drain=False)
+        dsnap = door_tel.registry.snapshot()
+        summary["failovers"] = dsnap.get("frontdoor/failovers", 0)
+        summary["replica_lost"] = dsnap.get("frontdoor/replica_lost", 0)
+        summary["pool_exhausted"] = dsnap.get(
+            "frontdoor/pool_exhausted", 0)
+        summary["survivor_re_traces"] = tels[1].registry.snapshot().get(
+            "serving/program_cache_misses", 0.0) - miss0
+        res["pool"] = summary
+        res["pool_zero_stranded"] = bool(
+            summary["completed"] + summary["shed"]
+            + summary["faulted"] + summary["errors"] == n)
+        res["pool_survivor_retrace_free"] = bool(
+            summary["survivor_re_traces"] == 0)
+        log(f"serve pool: completed={summary['completed']} "
+            f"failovers={summary['failovers']}, "
+            f"replica_lost={summary['replica_lost']}, "
+            f"survivor_re_traces={summary['survivor_re_traces']}, "
+            f"zero_stranded={res['pool_zero_stranded']}")
     res["warm_retrace_free"] = bool(
         res.get("warm", {}).get("re_traces", 1) == 0)
     res["cached_warm_retrace_free"] = bool(
@@ -1994,6 +2058,8 @@ def run_stage(name: str, args, env, timeout_s: int, retries: int,
             cmd.append("--serve_prewarm")
         if getattr(args, "serve_chaos", False):
             cmd.append("--serve_chaos")
+        if getattr(args, "serve_pool", False):
+            cmd.append("--serve_pool")
     last = "never ran"
     killed_prev = False
     for attempt in range(1 + retries):
@@ -2124,6 +2190,14 @@ def main():
     # default: the device-lost rebuild re-runs prewarm (~1 extra cold
     # compile pass of stage budget).
     ap.add_argument("--serve_chaos", action="store_true")
+    # serve stage: also run a replicated front-door phase — the same
+    # workload through a 2-replica health-checked pool with a
+    # serving.replica_lost fault killing r0 mid-replay, reporting
+    # failover count, survivor re-traces (must be 0: every replica
+    # prewarmed), and the pool zero-stranded acceptance
+    # (docs/SERVING.md "Front door"). Off by default: it builds and
+    # prewarms two full engines (~2 extra cold passes of stage budget).
+    ap.add_argument("--serve_pool", action="store_true")
     # stamp the final result with a hardware/software fingerprint
     # (platform, device kind, jax version) so scripts/compare_runs.py
     # can refuse to diff evidence from different experiments — two

@@ -19,6 +19,11 @@ from typing import Dict
 ALLOWLIST: Dict[str, Dict[str, int]] = {
     "callback-leak": {},
     "host-sync": {
+        # front-door routing is pure control plane: explicit ZERO pins
+        # (ISSUE 16) — any numpy/jax host sync appearing on the
+        # routing path is a regression, not new debt to budget
+        "flaxdiff_tpu/serving/frontdoor.py": 0,
+        "flaxdiff_tpu/serving/replica.py": 0,
         "flaxdiff_tpu/serving/loadgen.py": 2,
         "flaxdiff_tpu/trainer/autoencoder_trainer.py": 4,
         "flaxdiff_tpu/trainer/logging.py": 2,

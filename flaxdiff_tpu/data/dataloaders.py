@@ -10,7 +10,7 @@ host boundary — no per-step device sync.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, Iterator, Optional
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 import jax
 import numpy as np
@@ -161,13 +161,23 @@ def get_dataset_grain(dataset: MediaDataset,
     ops.append(pygrain.Batch(batch_size=local_bs,
                              drop_remainder=drop_remainder))
 
-    def make_loader(epoch_seed: int):
+    def make_loader(epoch_seed: int,
+                    shard: Optional[Tuple[int, int]] = None):
+        # default: launch-time jax process world; an explicit
+        # (rank, size) shard override re-shards the index sampler for
+        # a post-shrink elastic world (the `reshard` factory below)
+        shard_options = (
+            pygrain.ShardOptions(shard_index=shard[0],
+                                 shard_count=shard[1],
+                                 drop_remainder=True)
+            if shard is not None
+            else pygrain.ShardByJaxProcess(drop_remainder=True))
         sampler = pygrain.IndexSampler(
             num_records=len(source),
             shuffle=True,
             seed=epoch_seed,
             num_epochs=1,
-            shard_options=pygrain.ShardByJaxProcess(drop_remainder=True),
+            shard_options=shard_options,
         )
         read_options = None
         if read_threads is not None or read_buffer_size is not None:
@@ -186,11 +196,25 @@ def get_dataset_grain(dataset: MediaDataset,
         )
 
     n = len(source) // jax.process_count()
+
+    def reshard(rank: int, size: int) -> GrainLoader:
+        """Rebuild the grain pipeline for a changed world: the index
+        sampler re-shards over the surviving (rank, size) instead of
+        the launch-time jax process world, so an elastic shrink
+        re-partitions the dataset across survivors with no records
+        orphaned on dead hosts. Batch geometry is unchanged — each
+        survivor still emits `local_batch_size` batches."""
+        per = len(source) // max(size, 1)
+        return GrainLoader(
+            lambda es: make_loader(es, shard=(rank, size)),
+            max(per // local_bs, 1))
+
     return {
         "train": GrainLoader(make_loader, max(n // local_bs, 1)),
         "train_len": len(source),
         "local_batch_size": local_bs,
         "global_batch_size": batch_size,
+        "reshard": reshard,
     }
 
 

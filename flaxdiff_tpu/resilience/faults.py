@@ -33,6 +33,12 @@ Known sites (the framework's barriers; plans may name new ones freely):
     serving.device_lost  ServingScheduler dispatch, before each round
                   (use error="flag"): raises DeviceLost -> the
                   EngineSupervisor drains, rebuilds, prewarms, requeues
+    serving.replica_lost  FrontDoor.submit admission: polled once per
+                  replica per submission with key="replica:<name>:"
+                  (use error="flag", per_key=True, match the target
+                  replica) — a firing kills that whole replica
+                  (non-draining close); the door marks it DEAD and
+                  fails its in-flight requests over to survivors
 
 A plan is JSON-serializable and env-drivable::
 

@@ -12,14 +12,30 @@
                  supervision/rebuild (`EngineSupervisor`), brownout
                  degradation (`BrownoutPolicy`) — docs/SERVING.md
                  "Failure semantics"
-    loadgen      seeded Poisson workload build + replay (bench.py serve)
+    replica      one health-tracked scheduler unit (HEALTHY/DEGRADED/
+                 REBUILDING/DEAD) inside a pool
+    frontdoor    `FrontDoor.submit()` over a `ReplicaPool`: health-
+                 checked least-loaded routing, replica failover with a
+                 cross-replica attempt budget, hedged retries, pool-
+                 wide admission + brownout — docs/SERVING.md "Front
+                 door"
+    loadgen      seeded Poisson workload build + replay (bench.py
+                 serve), plus the multi-tenant open-loop harness for
+                 the front door (diurnal-ramp/burst shapes, per-tenant
+                 SLO attainment)
 
-SLO metrics ride the telemetry registry under `serving/*`
-(docs/OBSERVABILITY.md).
+SLO metrics ride the telemetry registry under `serving/*` and
+`frontdoor/*` (docs/OBSERVABILITY.md).
 """
 from .engine import (DEFAULT_BATCH_BUCKETS, RequestState,
                      SamplerProgramEngine, bucket_up, nfe_bucket)
-from .loadgen import PoissonWorkloadSpec, build_workload, replay
+from .frontdoor import (FrontDoor, FrontDoorConfig, HedgePolicy,
+                        ReplicaPool, build_pool)
+from .loadgen import (OpenLoopSpec, PoissonWorkloadSpec, TenantSpec,
+                      build_open_loop, build_workload, replay,
+                      run_open_loop)
+from .replica import (DEAD, DEGRADED, HEALTHY, REBUILDING, Replica,
+                      ReplicaHealthConfig)
 from .request import (DeadlineExceeded, SampleRequest, SampleResult,
                       SchedulerClosed, ServingFuture)
 from .scheduler import MS_BUCKET_BOUNDS, SchedulerConfig, ServingScheduler
@@ -27,11 +43,14 @@ from .supervision import (BrownoutConfig, BrownoutPolicy, DeviceLost,
                           EngineSupervisor, ServingFault, classify)
 
 __all__ = [
-    "BrownoutConfig", "BrownoutPolicy", "DEFAULT_BATCH_BUCKETS",
-    "DeadlineExceeded", "DeviceLost", "EngineSupervisor",
-    "MS_BUCKET_BOUNDS", "PoissonWorkloadSpec", "RequestState",
-    "SampleRequest", "SampleResult", "SamplerProgramEngine",
-    "SchedulerClosed", "SchedulerConfig", "ServingFault",
-    "ServingFuture", "ServingScheduler", "bucket_up", "build_workload",
-    "classify", "nfe_bucket", "replay",
+    "BrownoutConfig", "BrownoutPolicy", "DEAD", "DEFAULT_BATCH_BUCKETS",
+    "DEGRADED", "DeadlineExceeded", "DeviceLost", "EngineSupervisor",
+    "FrontDoor", "FrontDoorConfig", "HEALTHY", "HedgePolicy",
+    "MS_BUCKET_BOUNDS", "OpenLoopSpec", "PoissonWorkloadSpec",
+    "REBUILDING", "Replica", "ReplicaHealthConfig", "ReplicaPool",
+    "RequestState", "SampleRequest", "SampleResult",
+    "SamplerProgramEngine", "SchedulerClosed", "SchedulerConfig",
+    "ServingFault", "ServingFuture", "ServingScheduler", "bucket_up",
+    "build_open_loop", "build_pool", "build_workload", "classify",
+    "nfe_bucket", "replay", "run_open_loop",
 ]

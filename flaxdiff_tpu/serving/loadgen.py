@@ -1,14 +1,33 @@
-"""Seeded Poisson load generation + replay against the scheduler.
+"""Seeded load generation + replay against a scheduler or front door.
 
 One seeded `numpy` Generator drives everything — inter-arrival gaps
 (exponential), template choice, and per-request seeds — so a spec
 builds the *identical* workload every time: the `bench.py serve` stage
 replays the same list twice to prove the warm program cache re-traces
 nothing, and tests assert replay determinism outright.
+
+Two harnesses share that determinism contract:
+
+- `build_workload` + `replay`: the original single-stream Poisson
+  replay (closed set of futures, one submitting thread).
+- `OpenLoopSpec`/`TenantSpec` + `build_open_loop` + `run_open_loop`:
+  the multi-worker OPEN-loop harness for the front door
+  (serving/frontdoor.py). Each tenant emits its own deterministic
+  arrival stream in one of three shapes — `poisson` (flat),
+  `ramp`/`diurnal` (rate swells to `peak_factor`× and back, the
+  diurnal daily curve compressed into the run), `burst` (bursts of
+  `burst_len` back-to-back arrivals separated by idle gaps) — and the
+  merged stream is submitted open-loop by `workers` threads on the
+  arrival clock: a slow pool makes requests PILE UP rather than
+  slowing the offered load, which is what exposes brownout/admission
+  behaviour. The report carries per-tenant SLO attainment (fraction
+  of a tenant's requests that completed within its `slo_ms`).
 """
 from __future__ import annotations
 
 import dataclasses
+import math
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -112,4 +131,201 @@ def replay(scheduler, workload: List[Tuple[float, SampleRequest]],
              for r in results])) if results else None,
         "rounds_mean": float(np.mean([r.rounds for r in results]))
         if results else None,
+    }
+
+
+# -- multi-worker open-loop harness (front door) -----------------------------
+
+@dataclasses.dataclass
+class TenantSpec:
+    """One tenant's deterministic traffic stream.
+
+    shape: "poisson" (flat rate_hz), "ramp"/"diurnal" (rate swells
+      from rate_hz to peak_factor*rate_hz at the stream's midpoint and
+      back — sin^2 profile), "burst" (groups of `burst_len` arrivals
+      at peak_factor*rate_hz separated by `burst_idle_s` of silence).
+    slo_ms: the tenant's latency objective — a request attains it when
+      it completes with latency_ms <= slo_ms (shed/faulted/errored
+      requests never attain).
+    seed: per-tenant generator seed; None derives one from the pool
+      spec's seed + tenant index, so adding a tenant never perturbs
+      the others' streams.
+    """
+    name: str = "default"
+    n_requests: int = 32
+    rate_hz: float = 4.0
+    shape: str = "poisson"
+    peak_factor: float = 4.0
+    burst_len: int = 8
+    burst_idle_s: float = 2.0
+    mix: Sequence[Dict[str, Any]] = (
+        {"resolution": 64, "diffusion_steps": 16, "sampler": "ddim"},)
+    slo_ms: float = 60_000.0
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class OpenLoopSpec:
+    """A set of tenants sharing one front door; `seed` derives every
+    tenant's generator (unless the tenant pins its own)."""
+    tenants: Sequence[TenantSpec] = (TenantSpec(),)
+    seed: int = 0
+
+
+def _tenant_arrivals(t: TenantSpec, rng) -> List[float]:
+    """Deterministic arrival offsets for one tenant (seconds)."""
+    if t.shape not in ("poisson", "ramp", "diurnal", "burst"):
+        raise ValueError(f"unknown traffic shape {t.shape!r}")
+    out: List[float] = []
+    clock = 0.0
+    for k in range(t.n_requests):
+        if t.shape in ("ramp", "diurnal"):
+            frac = k / max(1, t.n_requests - 1)
+            rate = t.rate_hz * (1.0 + (t.peak_factor - 1.0)
+                                * math.sin(math.pi * frac) ** 2)
+            clock += float(rng.exponential(1.0 / rate))
+        elif t.shape == "burst":
+            if k and k % max(1, t.burst_len) == 0:
+                clock += t.burst_idle_s
+            clock += float(rng.exponential(
+                1.0 / (t.rate_hz * t.peak_factor)))
+        else:
+            clock += float(rng.exponential(1.0 / t.rate_hz))
+        out.append(clock)
+    return out
+
+
+def build_open_loop(spec: OpenLoopSpec
+                    ) -> List[Tuple[float, str, SampleRequest]]:
+    """[(arrival_offset_s, tenant_name, request)] merged across
+    tenants, time-sorted — deterministic in `spec`."""
+    merged: List[Tuple[float, str, SampleRequest]] = []
+    for i, t in enumerate(spec.tenants):
+        seed = t.seed if t.seed is not None \
+            else spec.seed * 1_000_003 + i
+        rng = np.random.default_rng(seed)
+        for offset in _tenant_arrivals(t, rng):
+            template = dict(t.mix[int(rng.integers(len(t.mix)))])
+            template.setdefault("seed", int(rng.integers(2 ** 31)))
+            merged.append((offset, t.name, SampleRequest(**template)))
+    merged.sort(key=lambda x: (x[0], x[1]))
+    return merged
+
+
+def _submit_worker(door, items, t0: float, speed: float, sink: list,
+                   lock: threading.Lock) -> None:
+    """One open-loop submitter: fires its slice of the merged stream
+    on the arrival clock regardless of how fast the pool drains."""
+    for offset, tenant, req in items:
+        delay = offset / speed - (time.perf_counter() - t0)
+        if delay > 0:
+            time.sleep(delay)
+        fut = door.submit(req)
+        with lock:
+            sink.append((tenant, req, fut))
+
+
+def run_open_loop(door, spec: OpenLoopSpec, workers: int = 2,
+                  speed: float = 1.0, timeout_s: float = 300.0,
+                  workload: Optional[List[Tuple[float, str,
+                                                SampleRequest]]] = None
+                  ) -> Dict[str, Any]:
+    """Drive the merged tenant streams at the front door with
+    `workers` open-loop submitter threads; wait for every future and
+    report overall + per-tenant SLO attainment. Pass `workload` to
+    replay a pre-built (e.g. already-inspected) stream."""
+    if workload is None:
+        workload = build_open_loop(spec)
+    slo_by_tenant = {t.name: t.slo_ms for t in spec.tenants}
+    n_workers = max(1, min(workers, len(workload) or 1))
+    # round-robin partition keeps every worker's slice time-sorted
+    slices: List[List[Tuple[float, str, SampleRequest]]] = [
+        workload[i::n_workers] for i in range(n_workers)]
+    sink: List[Tuple[str, SampleRequest, Any]] = []
+    lock = threading.Lock()
+    t0 = time.perf_counter()
+    threads = [threading.Thread(
+        target=_submit_worker, args=(door, s, t0, speed, sink, lock),
+        name=f"loadgen-w{i}", daemon=True)
+        for i, s in enumerate(slices)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+
+    per: Dict[str, Dict[str, Any]] = {
+        t.name: {"requests": 0, "completed": 0, "shed": 0,
+                 "faulted": 0, "errors": 0, "attained": 0,
+                 "latencies": []}
+        for t in spec.tenants}
+    all_lat: List[float] = []
+    completed = shed = faulted = errors = 0
+    for tenant, _req, fut in sink:
+        row = per.setdefault(tenant, {
+            "requests": 0, "completed": 0, "shed": 0, "faulted": 0,
+            "errors": 0, "attained": 0, "latencies": []})
+        row["requests"] += 1
+        try:
+            res = fut.result(timeout=timeout_s)
+        except DeadlineExceeded:
+            row["shed"] += 1
+            shed += 1
+            continue
+        except ServingFault:
+            row["faulted"] += 1
+            faulted += 1
+            continue
+        except Exception:
+            row["errors"] += 1
+            errors += 1
+            continue
+        completed += 1
+        row["completed"] += 1
+        row["latencies"].append(res.latency_ms)
+        all_lat.append(res.latency_ms)
+        if res.latency_ms <= slo_by_tenant.get(tenant, float("inf")):
+            row["attained"] += 1
+    wall = time.perf_counter() - t0
+
+    tenants: Dict[str, Any] = {}
+    for name, row in per.items():
+        lats = row.pop("latencies")
+        n = row["requests"]
+        tenants[name] = {
+            **row,
+            "slo_ms": slo_by_tenant.get(name),
+            "slo_attainment": row["attained"] / n if n else None,
+            "latency_ms": {"p50": _pct(lats, 50), "p99": _pct(lats, 99),
+                           "mean": (sum(lats) / len(lats)
+                                    if lats else None)},
+        }
+    # per-tenant SLO rows into the door's telemetry stream, so
+    # scripts/diagnose_run.py's "Front door" section can render the
+    # attainment table post-hoc from telemetry.jsonl alone
+    tel = getattr(door, "telemetry", None)
+    if tel is not None:
+        for name, row in tenants.items():
+            tel.write_record({
+                "type": "tenant_slo", "tenant": name,
+                "requests": row["requests"],
+                "completed": row["completed"], "shed": row["shed"],
+                "faulted": row["faulted"], "errors": row["errors"],
+                "slo_ms": row["slo_ms"],
+                "slo_attainment": row["slo_attainment"],
+                "p50_ms": row["latency_ms"]["p50"],
+                "p99_ms": row["latency_ms"]["p99"]})
+    return {
+        "requests": len(workload),
+        "workers": n_workers,
+        "completed": completed,
+        "shed": shed,
+        "faulted": faulted,
+        "errors": errors,
+        "wall_s": round(wall, 3),
+        "throughput_rps": round(completed / wall, 3) if wall else None,
+        "latency_ms": {"p50": _pct(all_lat, 50), "p99": _pct(all_lat, 99),
+                       "mean": (sum(all_lat) / len(all_lat)
+                                if all_lat else None),
+                       "max": max(all_lat) if all_lat else None},
+        "tenants": tenants,
     }

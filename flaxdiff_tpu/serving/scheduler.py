@@ -254,6 +254,58 @@ class ServingScheduler:
         if self._started:
             self._completer.join(timeout)
 
+    # -- pool introspection (serving/replica.py) ------------------------------
+    # Host-side accessors for the replica/front-door layer: routing
+    # reads these on every submit, so they must stay lock-bounded
+    # bookkeeping — no device work, no blocking waits.
+    @property
+    def closed(self) -> bool:
+        """True once close() (or a thread-death sweep) stopped
+        admission — the replica layer's DEAD signal."""
+        return self._closed
+
+    def queue_depth(self) -> int:
+        """Queued (not yet dispatched) requests right now."""
+        with self._lock:
+            return len(self._queue)
+
+    def load(self) -> int:
+        """Total requests this scheduler is responsible for: queued +
+        active rows + completed batches awaiting the host fetch. The
+        front door's least-loaded routing key."""
+        with self._lock:
+            n = len(self._queue)
+            for rows in self._active.values():
+                n += len(rows)
+            for rows, _, _ in self._completions:
+                n += len(rows)
+            return n
+
+    def cancel(self, fut: ServingFuture) -> bool:
+        """Best-effort cancel of a QUEUED request by its future — the
+        front door reaps a hedge loser with this before it costs any
+        compute. A request already dispatched (active or in flight to
+        the completion thread) is not cancellable; first-set-wins on
+        the future makes its late result harmless. Returns True when a
+        queued entry was removed."""
+        with self._cv:
+            hit = False
+            kept: Deque = deque()
+            for e in self._queue:
+                if e.fut is fut and not hit:
+                    hit = True
+                    self.telemetry.counter("serving/cancelled").inc()
+                    self.tracer.shed(e.trace, "cancelled", _now())
+                    e.fut.set_exception(
+                        SchedulerClosed("cancelled by caller"))
+                else:
+                    kept.append(e)
+            if hit:
+                self._queue = kept
+                self.telemetry.gauge("serving/queue_depth").set(
+                    len(self._queue))
+            return hit
+
     # -- admission ------------------------------------------------------------
     def submit(self, req: SampleRequest) -> ServingFuture:
         """Enqueue one request. Never blocks: overload and post-close
@@ -405,7 +457,20 @@ class ServingScheduler:
         With `penalize`, the attempt counts against the bounded retry
         budget and the re-dispatch waits out the policy's backoff;
         rebuild interruptions requeue unpenalized (the device fault was
-        not theirs). Held lock."""
+        not theirs). Held lock.
+
+        Close race: a non-draining `close()` sweeps the queue and
+        resolves everything it can see, but rows a rebuild (or a
+        fetch-fault retry) holds in a local list at that instant are
+        invisible to the sweep — requeueing them afterwards would
+        strand their futures with the dispatch loop already exiting.
+        Resolve them here instead (chaos-tested)."""
+        if self._closed and not self._draining:
+            for r in states:
+                self.tracer.shed(r.trace, "closed", now)
+                r.future.set_exception(
+                    SchedulerClosed("scheduler closed"))
+            return
         retry = self.config.retry
         delays = retry.delays()
         for r in states:
@@ -575,6 +640,9 @@ class ServingScheduler:
         never clobbered)."""
         with self._cv:
             self._closed = True
+            # a completion thread dying mid-batch must not leave the
+            # rebuild DRAINING wait spinning on `_processing`
+            self._processing = False
             for e in self._queue:
                 e.fut.set_exception(fault)
             self._queue.clear()

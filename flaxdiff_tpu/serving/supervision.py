@@ -56,6 +56,11 @@ class ServingFault(Exception):
         fetch_error        completion fetch failed after dispatch ended
         device_lost        device died and no engine_factory exists
         scheduler_died     the dispatch/completion thread crashed
+        pool_exhausted     front door only (serving/frontdoor.py): the
+                           cross-replica attempt budget ran out, or no
+                           routable replica remains — raised even when
+                           ALL replicas die, so pool futures are never
+                           stranded
     """
 
     def __init__(self, msg: str, kind: str = "round_error",

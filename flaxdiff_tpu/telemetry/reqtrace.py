@@ -89,8 +89,13 @@ class RequestTracer:
     returns None) when the hub has no trace recorder, so the scheduler
     carries the tracer unconditionally."""
 
-    def __init__(self, telemetry):
+    def __init__(self, telemetry, prefix: str = "req"):
+        # `prefix` namespaces the minted trace ids: the front door and
+        # each replica scheduler carry their OWN tracer over one shared
+        # hub, and a door-level trace must never collide with a
+        # replica-level one for the same request
         self.telemetry = telemetry
+        self.prefix = prefix
         self._seq = itertools.count()
         self._pid = os.getpid()
 
@@ -105,8 +110,8 @@ class RequestTracer:
         if not self.enabled:
             return None
         seq = next(self._seq)
-        tr = RequestTrace(f"req-{self._pid}-{seq}", seq, submit_s,
-                          _req_summary(req))
+        tr = RequestTrace(f"{self.prefix}-{self._pid}-{seq}", seq,
+                          submit_s, _req_summary(req))
         self.telemetry.recorder.instant_at(
             "req.submit", submit_s, cat="serving",
             args={"trace_id": tr.trace_id, **tr.summary}, tid=tr.tid)

@@ -955,10 +955,14 @@ class DiffusionTrainer:
                               restore_step=None, t0=t0,
                               in_ckpt_phase=True)
 
-        def _elastic_quorum(hard: bool, step_no: int) -> None:
-            """Pod anomaly quorum at a numerics-cadence step: every
-            member votes; a sick-pod majority rolls everyone back to
-            the consensus step, an outlier minority is evicted."""
+        def _elastic_quorum(hard: bool, step_no: int) -> Optional[str]:
+            """Pod anomaly quorum at a collective step (the numerics
+            cadence, or — with `numerics_cadence=0` — the log-step
+            window fetch): every member votes; a sick-pod majority
+            rolls everyone back to the consensus step, an outlier
+            minority is evicted. Returns the decision kind (None when
+            the round itself failed) so the caller knows whether the
+            anomaly was handled collectively."""
             from ..resilience.elastic import ElasticError
             t0 = time.perf_counter()
             try:
@@ -970,9 +974,9 @@ class DiffusionTrainer:
                                        in_ckpt_phase=False):
                     history["coordination_lost"] = True
                     stop["flag"] = True
-                return
+                return None
             if decision.kind == "none":
-                return
+                return "none"
             tel.write_record({
                 "type": "quorum_decision", "kind": decision.kind,
                 "step": step_no,
@@ -1011,6 +1015,7 @@ class DiffusionTrainer:
                 _adopt_change(decision.change, bucket="quorum_rollback",
                               restore_step=None, t0=t0,
                               in_ckpt_phase=False)
+            return decision.kind
 
         def commit_save(final: bool = False) -> None:
             """Two-phase-commit the save just dispatched (no-op without
@@ -1430,10 +1435,35 @@ class DiffusionTrainer:
                     # the detector's hard triggers subsume the old
                     # `isfinite or <= floor` ad-hoc check
                     loss = vals[-1] if vals else float("nan")
+                    anomaly = (None if recovered
+                               else detector.abnormal_loss(loss,
+                                                           step=i + 1))
+                    if not recovered and elastic is not None \
+                            and cfg.anomaly_action == "rollback" \
+                            and cfg.numerics_cadence == 0:
+                        # numerics_cadence=0 quorum hole, closed: with
+                        # no cadence step the hard verdict surfaces
+                        # HERE, and a unilateral local rollback would
+                        # silently fork the pod. Every member reaches
+                        # every log step in lockstep, so the vote is
+                        # collective by construction — healthy members
+                        # vote False each window, the anomalous one
+                        # votes True, and the pod decides together
+                        # (rollback_all restores + clears the window
+                        # inside _elastic_quorum). A failed round never
+                        # falls back to the unilateral path: that is
+                        # the fork this guard exists to prevent.
+                        with timer.phase("elastic"):
+                            verdict = _elastic_quorum(
+                                anomaly is not None, i + 1)
+                        if anomaly is not None \
+                                or verdict in ("rollback_all", "evicted"):
+                            steps_in_window = 0
+                            log_t0 = time.perf_counter()
+                            recovered = True
                     if recovered:
                         pass    # transition emptied the window above
-                    elif detector.abnormal_loss(loss,
-                                                step=i + 1) is not None:
+                    elif anomaly is not None:
                         self._recover(loss, step=i + 1)
                         steps_in_window = 0
                         log_t0 = time.perf_counter()

@@ -284,6 +284,81 @@ def serving_section(metrics: List[Dict], lines: List[str]) -> None:
     lines.append("")
 
 
+def frontdoor_section(metrics: List[Dict], health: List[Dict],
+                      tenant_slo: List[Dict],
+                      lines: List[str]) -> None:
+    """Replicated front-door report (docs/SERVING.md "Front door"):
+    request accounting across the pool, hedge/failover counts, the
+    per-replica health timeline from `frontdoor_health` records, and
+    per-tenant SLO attainment when a loadgen open-loop run recorded
+    `tenant_slo` rows."""
+    last = metrics[-1] if metrics else {}
+    have_metrics = any(k.startswith("frontdoor/") for k in last)
+    if not have_metrics and not health and not tenant_slo:
+        return
+    lines.append("== Front door ==")
+
+    def g(name: str, default=0.0):
+        v = last.get(name, default)
+        return float(v) if isinstance(v, (int, float)) else default
+
+    if have_metrics:
+        req_in, ok, shed = (g("frontdoor/requests_in"),
+                            g("frontdoor/requests_ok"),
+                            g("frontdoor/shed"))
+        lines.append(f"requests in/ok/shed: {req_in:.0f} / {ok:.0f} "
+                     f"/ {shed:.0f}"
+                     + (f"  (shed {shed / req_in:.1%})" if req_in
+                        else ""))
+        lines.append(f"routing:            {g('frontdoor/routed'):.0f} "
+                     f"routed, {g('frontdoor/failovers'):.0f} failovers, "
+                     f"{g('frontdoor/replica_lost'):.0f} replicas lost, "
+                     f"{g('frontdoor/pool_exhausted'):.0f} pool-exhausted")
+        hedges = g("frontdoor/hedges")
+        if hedges:
+            lines.append(
+                f"hedging:            {hedges:.0f} hedged, "
+                f"{g('frontdoor/hedge_wins'):.0f} hedge wins, "
+                f"{g('frontdoor/hedge_cancelled'):.0f} losers cancelled")
+        cnt = g("frontdoor/latency_ms/count")
+        if cnt:
+            lines.append(
+                f"door latency_ms:    p50 "
+                f"{g('frontdoor/latency_ms/p50'):>9.2f}   p99 "
+                f"{g('frontdoor/latency_ms/p99'):>9.2f}   max "
+                f"{g('frontdoor/latency_ms/max'):>9.2f}   n {cnt:.0f}")
+    if health:
+        # one timeline per replica: every recorded health TRANSITION
+        per: Dict[str, List[Dict]] = {}
+        for r in health:
+            per.setdefault(str(r.get("replica", "?")), []).append(r)
+        for name in sorted(per):
+            hops = " -> ".join(
+                f"{r.get('health', '?')}@{float(r.get('t_s', 0.0)):.2f}s"
+                for r in per[name])
+            tail = per[name][-1]
+            lines.append(f"replica {name:<10s} {hops} "
+                         f"(fault_rate {float(tail.get('fault_rate', 0.0)):.2f}, "
+                         f"load {tail.get('load', '?')})")
+    if tenant_slo:
+        lines.append(f"{'tenant':<14s} {'req':>5s} {'ok':>5s} "
+                     f"{'shed':>5s} {'fault':>5s} {'slo ms':>8s} "
+                     f"{'attain':>7s} {'p99 ms':>9s}")
+        for t in tenant_slo:
+            att = t.get("slo_attainment")
+            p99 = t.get("p99_ms")
+            lines.append(
+                f"{str(t.get('tenant', '?')):<14s} "
+                f"{int(t.get('requests', 0)):>5d} "
+                f"{int(t.get('completed', 0)):>5d} "
+                f"{int(t.get('shed', 0)):>5d} "
+                f"{int(t.get('faulted', 0)):>5d} "
+                f"{float(t.get('slo_ms') or 0.0):>8.0f} "
+                f"{(f'{att:.1%}' if isinstance(att, (int, float)) else '-'):>7s} "
+                f"{(f'{p99:.2f}' if isinstance(p99, (int, float)) else '-'):>9s}")
+    lines.append("")
+
+
 def reqtrace_section(traces: List[Dict], lines: List[str]) -> None:
     """Request-level latency attribution (telemetry/reqtrace.py): the
     per-span breakdown across every traced request, plus a drill-down
@@ -432,6 +507,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                    if r.get("type") == "elastic_transition"]
     quorum = [r for r in records if r.get("type") == "quorum_decision"]
     reqtraces = [r for r in records if r.get("type") == "request_trace"]
+    fd_health = [r for r in records
+                 if r.get("type") == "frontdoor_health"]
+    tenant_slo = [r for r in records if r.get("type") == "tenant_slo"]
 
     programs: List[Dict] = []
     prog_path = os.path.join(directory, "programs.jsonl")
@@ -478,7 +556,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                    "quorum_decisions": quorum,
                    "world_timeline": [int(t.get("world", 0))
                                       for t in transitions],
-                   "reclaimed_s": dict(goodput.get("reclaimed_s", {}))}}
+                   "reclaimed_s": dict(goodput.get("reclaimed_s", {}))},
+               "frontdoor": {
+                   "health_timeline": fd_health,
+                   "tenant_slo": tenant_slo,
+                   "counters": {k: v for k, v in
+                                (metrics[-1] if metrics else {}).items()
+                                if k.startswith("frontdoor/")}}}
         ok_traces = [t for t in reqtraces
                      if t.get("outcome", "ok") == "ok"]
         span_stats = {}
@@ -509,6 +593,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     health_section(numerics, anomalies, provenance, metrics, lines)
     pod_section(pods, lines)
     serving_section(metrics, lines)
+    frontdoor_section(metrics, fd_health, tenant_slo, lines)
     reqtrace_section(reqtraces, lines)
     programs_section(programs, lines)
     counters_section(metrics, lines)

@@ -462,3 +462,33 @@ def test_elastic_quorum_rollback_all_in_fit(tmp_path, rng):
     assert ck.ledger.quorum_decisions()[-1]["decision"] == "rollback_all"
     assert np.isfinite(hist["final_loss"])
     ck.close()
+
+
+def test_elastic_quorum_rides_log_step_with_cadence_zero(tmp_path, rng):
+    """ISSUE 16 satellite — the numerics_cadence=0 quorum hole: with no
+    cadence step, a hard non-finite anomaly surfaces only at the
+    log-step loss-window fetch. That anomaly must enter the pod quorum
+    (collective vote at every log step) instead of falling back to a
+    unilateral local rollback that would fork the pod."""
+    from flaxdiff_tpu.parallel import create_mesh
+    mgr, ck = _solo_elastic_world(tmp_path / "q0")
+    # step.nan poisons the loss the NEXT readback sees — with
+    # cadence 0 that readback IS the log-step window fetch
+    plan = R.FaultPlan([R.FaultSpec("step.nan", at=(3,),
+                                    error="flag", times=1)])
+    ev = R.EventLog("elastic-test")
+    with R.use_event_log(ev), plan.installed():
+        tr = _tiny_trainer(create_mesh(axes={"data": -1}), ckpt=ck,
+                           elastic=mgr, log_every=2, keep_best_state=False,
+                           numerics_cadence=0, anomaly_action="rollback")
+        hist = tr.fit(_data(rng), total_steps=8, save_every=2)
+    ck.wait_until_finished()
+    # the anomaly was handled COLLECTIVELY: quorum decision recorded,
+    # no unilateral best-state/checkpoint rollback event
+    assert hist.get("quorum") == ["rollback_all"]
+    assert ev.count("quorum_rollback", "elastic.quorum") == 1
+    assert ev.count("rollback", "train.step") == 0
+    assert hist["goodput"]["badput_s"].get("quorum_rollback", 0.0) > 0.0
+    assert ck.ledger.quorum_decisions()[-1]["decision"] == "rollback_all"
+    assert np.isfinite(hist["final_loss"])
+    ck.close()

@@ -16,6 +16,7 @@ Scheduler mechanics run against the jax-free FakeEngine pattern from
 tests/test_serving.py; the bit-identity and rebuild-prewarm bars run
 against a real tiny pipeline.
 """
+import threading
 import time
 
 import numpy as np
@@ -23,9 +24,9 @@ import pytest
 
 from flaxdiff_tpu import resilience as R
 from flaxdiff_tpu.serving import (BrownoutConfig, DeviceLost,
-                                  SampleRequest, SchedulerConfig,
-                                  ServingFault, ServingScheduler,
-                                  classify)
+                                  SampleRequest, SchedulerClosed,
+                                  SchedulerConfig, ServingFault,
+                                  ServingScheduler, classify)
 from flaxdiff_tpu.serving import scheduler as sched_mod
 from flaxdiff_tpu.telemetry import Telemetry
 from tests.test_serving import FakeEngine
@@ -286,6 +287,60 @@ def test_fault_raises_brownout_floor():
         out = later.result(timeout=20)
         sched.close()
     assert out.degraded == ("nfe_capped",)
+
+
+# ---------------------------------------------------------------------------
+# close() racing an active supervised rebuild (ISSUE 16 satellite)
+# ---------------------------------------------------------------------------
+
+def _rebuild_race(drain):
+    """Drive the scheduler into `EngineSupervisor.rebuild()` (factory
+    blocked on a gate), call close() from another thread mid-rebuild,
+    release the gate, and return (futures, close_thread)."""
+    tel = Telemetry(enabled=False)
+    gate, entered = threading.Event(), threading.Event()
+
+    def factory():
+        entered.set()
+        assert gate.wait(20), "close() must not cancel the rebuild gate"
+        return FakeEngine()
+
+    eng, sched = _sched(tel, engine=FakeEngine(), engine_factory=factory)
+    plan = R.FaultPlan([R.FaultSpec("serving.device_lost", at=(1,),
+                                    times=1, error="flag")], seed=0)
+    with plan.installed():
+        futs = [sched.submit(r) for r in _reqs(3)]
+        sched.start()
+        assert entered.wait(20)         # dispatch thread is mid-rebuild
+        closer = threading.Thread(
+            target=lambda: sched.close(drain=drain, timeout=30))
+        closer.start()
+        time.sleep(0.1)                 # close's sweep runs first
+        gate.set()                      # rebuild lands, requeue follows
+        closer.join(30)
+    assert not closer.is_alive(), "close() hung against the rebuild"
+    return futs
+
+
+def test_close_nondraining_races_rebuild_resolves_all():
+    """The stranding race: a non-draining close sweeps the queue while
+    the rebuild holds the interrupted rows in a local list — the
+    post-rebuild requeue must RESOLVE those futures (SchedulerClosed),
+    not re-enter them into a queue nothing will ever serve."""
+    futs = _rebuild_race(drain=False)
+    for f in futs:
+        with pytest.raises(SchedulerClosed):
+            f.result(timeout=10)        # resolves; never hangs
+
+
+def test_close_draining_races_rebuild_completes_all():
+    """A DRAINING close during the rebuild lets the rebuilt engine
+    serve the interrupted requests to completion, unpenalized."""
+    futs = _rebuild_race(drain=True)
+    outs = [f.result(timeout=10) for f in futs]
+    for o in outs:
+        assert np.all(o.samples == float(o.request.seed))
+        assert o.attempts == 0          # rebuild requeue is unpenalized
 
 
 # ---------------------------------------------------------------------------

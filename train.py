@@ -745,6 +745,19 @@ def main(argv=None):
     # scripts/bench_text_encode.py; SURVEY §7.3(4)).
     from flaxdiff_tpu.data.prefetch import prefetch_map
     it = prefetch_map(encode_text, raw_iter, depth=2)
+
+    # Elastic re-shard hook (docs/RESILIENCE.md "Shrink-to-survive"):
+    # after a world change the trainer swaps in a pipeline rebuilt for
+    # the surviving (rank, size) — the grain index sampler re-shards,
+    # not just the online loader. Epoch-offset seed so the re-sharded
+    # stream does not replay the pre-shrink order.
+    data_factory = None
+    if "reshard" in loaded:
+        def data_factory(view):
+            resharded = loaded["reshard"](view.rank, view.size)
+            return prefetch_map(encode_text,
+                                resharded(seed=args.seed + view.epoch),
+                                depth=2)
     if args.flash_tune_cache:
         # shape-scouting + measured probes BEFORE the first compile, so
         # the train step picks the tuned per-shape plans up; the peeked
@@ -762,6 +775,7 @@ def main(argv=None):
                     args.total_steps - done)
         hist = trainer.fit(
             it, total_steps=chunk, save_every=args.save_every,
+            data_factory=data_factory,
             callbacks=[lambda s, l, m: logger.log(
                 {"loss": l, **m}, step=done + s)])
         done += chunk
