@@ -1323,6 +1323,248 @@ def stage_dispatch(args) -> dict:
     return res
 
 
+def stage_data_chaos(args) -> dict:
+    """ISSUE 17 acceptance: the deterministic data plane under REAL
+    injected corruption + a step.nan rollback, measured end to end.
+
+    Builds a packed-record shard with genuinely corrupted record bytes
+    (corruption that persists across replay — every decode of those
+    records fails forever, so the reference stream and the chaos
+    stream see the SAME placeholders), then runs a tiny fit through
+    `DataPlane` with a step.nan fault forcing an anomaly rollback
+    mid-run. Acceptance, all computed here:
+
+      bit_identical        — every batch the plane served (including
+                             re-served post-rollback batches) matches
+                             the uninterrupted reference digest at its
+                             index, and at least one index was served
+                             twice (the rollback actually replayed);
+      quarantine_accounted — the journal's record set equals the
+                             injected-corruption set exactly;
+      stranded_batches     — served indices are gap-free (no batch
+                             dropped or served out of order across the
+                             prefetcher teardown/rebuild);
+      leaked_threads       — no live prefetch worker after fit;
+      new_host_syncs       — the four counting-mock sync seams
+                             (trainer._block_until_ready/_fetch_losses/
+                             _fetch_ring/_fetch_gate_events) called
+                             EXACTLY as often as an identical control
+                             fit without the data plane — the plane
+                             adds zero device syncs (docs/DATA.md
+                             "Zero host syncs, by lint")."""
+    _apply_jax_platforms()
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import flax.linen as nn
+    from flaxdiff_tpu import resilience as R
+    from flaxdiff_tpu.data import DataPlane, QuarantineJournal
+    from flaxdiff_tpu.data.dataplane import batch_digest
+    from flaxdiff_tpu.data.packed_records import PackedRecordWriter
+    from flaxdiff_tpu.data.sharded_source import ShardedPackedRecordSource
+    from flaxdiff_tpu.parallel import create_mesh
+    from flaxdiff_tpu.predictors import EpsilonPredictionTransform
+    from flaxdiff_tpu.schedulers import CosineNoiseSchedule
+    from flaxdiff_tpu.trainer import (Checkpointer, DiffusionTrainer,
+                                      TrainerConfig)
+    from flaxdiff_tpu.trainer import trainer as trainer_mod
+
+    import cv2
+
+    n_records, batch, size = 64, 8, 16
+    corrupt = {5, 17, 40}
+    total_steps, save_every, nan_at = 24, 8, 13
+    work = tempfile.mkdtemp(prefix="bench_data_chaos_")
+    res = {"platform": jax.devices()[0].platform,
+           "total_steps": total_steps, "injected": sorted(corrupt)}
+    try:
+        # -- shard with REAL corruption (replays identically forever) --
+        shard = os.path.join(work, "chaos.pr")
+        rng = np.random.default_rng(7)
+        with PackedRecordWriter(shard) as w:
+            for i in range(n_records):
+                if i in corrupt:
+                    # undecodable image payload: cv2.imdecode -> None ->
+                    # ValueError -> quarantine, on EVERY decode
+                    w.write({"image": b"\xde\xad\xbe\xef" * 8,
+                             "caption": f"torn {i}".encode()})
+                    continue
+                img = rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
+                ok, enc = cv2.imencode(".png", img)
+                assert ok
+                w.write({"image": enc.tobytes(),
+                         "caption": f"img {i}".encode()})
+
+        def make_factory(journal):
+            src = ShardedPackedRecordSource(
+                shards=[shard], quarantine=journal,
+                placeholder_size=size).get_source()
+
+            def factory(seed):
+                def gen():
+                    epoch = 0
+                    while True:
+                        order = np.random.default_rng(
+                            seed + epoch).permutation(len(src))
+                        for s in range(0, len(src) - batch + 1, batch):
+                            imgs = [src[int(j)]["image"]
+                                    for j in order[s:s + batch]]
+                            x = (np.stack(imgs).astype(np.float32)
+                                 / 127.5) - 1.0
+                            yield {"sample": x}
+                        epoch += 1
+                return gen()
+            return factory
+
+        # -- uninterrupted reference digests ---------------------------
+        ref_it = make_factory(QuarantineJournal())(0)
+        reference = [batch_digest(next(ref_it)) for _ in range(64)]
+
+        class Tiny(nn.Module):
+            @nn.compact
+            def __call__(self, x, t, cond=None):
+                h = nn.Conv(8, (3, 3))(x)
+                return nn.Conv(x.shape[-1], (3, 3))(jnp.tanh(h))
+
+        model = Tiny()
+
+        def apply_fn(params, x, t, cond):
+            return model.apply({"params": params}, x, t, None)
+
+        def init_fn(key):
+            return model.init(key, jnp.zeros((1, size, size, 3)),
+                              jnp.zeros((1,)))["params"]
+
+        mesh = create_mesh(axes={"data": -1})
+
+        def make_trainer(ckdir, ev):
+            return DiffusionTrainer(
+                apply_fn=apply_fn, init_fn=init_fn, tx=optax.adam(1e-3),
+                schedule=CosineNoiseSchedule(timesteps=100),
+                transform=EpsilonPredictionTransform(), mesh=mesh,
+                config=TrainerConfig(normalize=False, log_every=2),
+                # single-host ledger: commit semantics without a
+                # coordinator, so data_state entries land beside commits
+                checkpointer=Checkpointer(ckdir, event_log=ev,
+                                          use_ledger=True))
+
+        SEAMS = ("_block_until_ready", "_fetch_losses", "_fetch_ring",
+                 "_fetch_gate_events")
+
+        def counted_fit(with_plane: bool):
+            counts = dict.fromkeys(SEAMS, 0)
+            saved = {s: getattr(trainer_mod, s) for s in SEAMS}
+
+            def wrap(name, fn):
+                def inner(*a, **k):
+                    counts[name] += 1
+                    return fn(*a, **k)
+                return inner
+            for s in SEAMS:
+                setattr(trainer_mod, s, wrap(s, saved[s]))
+            ev = R.EventLog("bench")
+            plan = R.FaultPlan([R.FaultSpec("step.nan", at=(nan_at,),
+                                            error="flag", times=1)])
+            served = []
+            journal = QuarantineJournal()
+
+            class RecordingPlane(DataPlane):
+                def __next__(self):
+                    idx = self.stream.cursor
+                    b = super().__next__()
+                    served.append((idx, self._digests[idx]))
+                    return b
+
+            ckdir = os.path.join(
+                work, "ck_plane" if with_plane else "ck_ctrl")
+            try:
+                with R.use_event_log(ev), plan.installed():
+                    trainer = make_trainer(ckdir, ev)
+                    if with_plane:
+                        plane = RecordingPlane(make_factory(journal),
+                                               seed=0, journal=journal)
+                        hist = trainer.fit(None, total_steps=total_steps,
+                                           save_every=save_every,
+                                           data_plane=plane)
+                    else:
+                        plane = None
+                        hist = trainer.fit(
+                            make_factory(journal)(0),
+                            total_steps=total_steps,
+                            save_every=save_every)
+                trainer.checkpointer.wait_until_finished()
+                ledger = trainer.checkpointer.ledger
+                data_states = 0
+                if plane is not None and ledger is not None:
+                    data_states = sum(
+                        1 for s in range(1, total_steps + 1)
+                        if ledger.data_state_at(s) is not None and
+                        ledger.data_state_at(s).get("cursor") == s)
+                trainer.checkpointer.close()
+            finally:
+                for s in SEAMS:
+                    setattr(trainer_mod, s, saved[s])
+            return {"counts": counts, "served": served,
+                    "journal": journal, "plane": plane, "hist": hist,
+                    "rollbacks": ev.count("rollback", "train.step"),
+                    "data_states": data_states}
+
+        chaos = counted_fit(with_plane=True)
+        control = counted_fit(with_plane=False)
+
+        served = chaos["served"]
+        mismatches = [(i, d) for i, d in served if reference[i] != d]
+        replayed = [i for i in {i for i, _ in served}
+                    if sum(1 for j, _ in served if j == i) > 1]
+        idxs = sorted({i for i, _ in served})
+        gap_free = idxs == list(range(len(idxs)))
+        journaled = sorted(
+            int(e["key"].split(":")[1])
+            for e in chaos["journal"].entries())
+        live = [t.name for t in threading.enumerate()
+                if t.is_alive() and "flaxdiff-put-batch" in t.name]
+        delta = {s: chaos["counts"][s] - control["counts"][s]
+                 for s in SEAMS}
+
+        res.update({
+            "rollbacks": chaos["rollbacks"],
+            "stream_rewinds": chaos["plane"].rewinds,
+            "batches_served": len(served),
+            "replayed_indices": len(replayed),
+            "bit_identical": not mismatches and len(replayed) > 0,
+            "digest_mismatches": mismatches[:8],
+            "journaled": journaled,
+            "quarantine_accounted": journaled == sorted(corrupt),
+            "ledger_data_states": chaos["data_states"],
+            "stranded_batches": 0 if gap_free else len(idxs),
+            "leaked_threads": live,
+            "host_syncs": {"with_plane": chaos["counts"],
+                           "control": control["counts"],
+                           "new": delta},
+            "zero_new_host_syncs": all(v == 0 for v in delta.values()),
+            "final_loss_finite": bool(
+                np.isfinite(chaos["hist"]["final_loss"])),
+        })
+        res["accepted"] = bool(
+            res["bit_identical"] and res["quarantine_accounted"]
+            and res["stranded_batches"] == 0 and not live
+            and res["zero_new_host_syncs"] and res["rollbacks"] >= 1
+            and res["ledger_data_states"] >= 1)
+        log(f"data_chaos: accepted={res['accepted']} "
+            f"bit_identical={res['bit_identical']} "
+            f"replayed={res['replayed_indices']} "
+            f"quarantined={journaled} new_syncs={delta}")
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return res
+
+
 def stage_longseq(args) -> dict:
     """Long-context attention on hardware: flash fwd+bwd at 8k/16k/32k
     tokens, XLA attempted at the same shapes for contrast.
@@ -1859,7 +2101,8 @@ STAGES = {"flashtune": stage_flashtune, "sweep": stage_sweep,
           "ddim": stage_ddim, "attnpad": stage_attnpad,
           "ablate": stage_ablate, "longseq": stage_longseq,
           "dispatch": stage_dispatch, "epilogue": stage_epilogue,
-          "serve": stage_serve, "diffcache": stage_diffcache}
+          "serve": stage_serve, "diffcache": stage_diffcache,
+          "data_chaos": stage_data_chaos}
 
 # info-value order (VERDICT r3 next #1): the headline sweep first, its
 # baseline second; refreal anchors vs_reference_binary; dispatch is the
@@ -1896,7 +2139,10 @@ STAGE_EST = {"sweep": 900, "ref": 450, "refreal": 700, "flashtune": 500,
              # 7 plans (4 CachePlans + 3 composed spatial) x (one
              # scan-program compile of a 12-layer DiT + `repeats`
              # timed DDIM-50 trajectories)
-             "diffcache": 720}
+             "diffcache": 720,
+             # two tiny-model fits (chaos + control) + one tiny compile
+             # + a 64-record packed shard written/decoded on the host
+             "data_chaos": 180}
 
 # stages that receive the flashtune winner env. Headline stages
 # (sweep/ref/ddim/sweep256) run with code defaults: an unvalidated
@@ -2198,6 +2444,13 @@ def main():
     # (docs/SERVING.md "Front door"). Off by default: it builds and
     # prewarms two full engines (~2 extra cold passes of stage budget).
     ap.add_argument("--serve_pool", action="store_true")
+    # data-plane chaos stage (docs/DATA.md): a packed shard with REAL
+    # corrupted record bytes fed through DataPlane under a step.nan
+    # rollback — reports bit-identical replay, quarantine accounting,
+    # zero stranded batches and zero new host syncs vs a control fit.
+    # Off by default (not in STAGE_ORDER): it is an acceptance drill,
+    # not a throughput number, and costs two tiny fits of budget.
+    ap.add_argument("--data_chaos", action="store_true")
     # stamp the final result with a hardware/software fingerprint
     # (platform, device kind, jax version) so scripts/compare_runs.py
     # can refuse to diff evidence from different experiments — two
@@ -2306,6 +2559,8 @@ def main():
     if args.quick:
         order = [s for s in order if s in ("sweep", "ref", "ddim",
                                            "flashtune")]
+    if args.data_chaos and "data_chaos" not in order:
+        order.append("data_chaos")
     if not order:
         # a typo'd --stages list must not end the run on a partial line
         result["terminated"] = "no runnable stages requested"

@@ -51,14 +51,14 @@ class HostSyncRule(AstRule):
 
     id = "host-sync"
     doc = ("host synchronization outside the blessed "
-           "_block_until_ready/_fetch_losses/_device_get seams in "
-           "trainer/, serving/, samplers/")
+           "_block_until_ready/_fetch_losses/_device_get/_host_asarray "
+           "seams in trainer/, serving/, samplers/, data/")
     roots = ("flaxdiff_tpu",)
-    dirs = ("trainer", "serving", "samplers")
+    dirs = ("trainer", "serving", "samplers", "data")
 
     BLESSED = frozenset({"_block_until_ready", "_fetch_losses",
                          "_fetch_ring", "_fetch_gate_events",
-                         "_device_get"})
+                         "_device_get", "_host_asarray"})
     _NP_NAMES = frozenset({"np", "numpy"})
 
     def check(self, relpath: str, tree: ast.AST,

@@ -29,6 +29,23 @@ ALLOWLIST: Dict[str, Dict[str, int]] = {
         "flaxdiff_tpu/trainer/logging.py": 2,
         "flaxdiff_tpu/trainer/trainer.py": 4,
         "flaxdiff_tpu/trainer/validation.py": 2,
+        # the deterministic data plane is host-side control plane:
+        # explicit ZERO pins (ISSUE 17) — every numpy materialization
+        # routes through the one blessed `_host_asarray` seam
+        # (data/dataplane.py), so a raw np.asarray/.item() appearing in
+        # these files is a regression, not new debt
+        "flaxdiff_tpu/data/dataplane.py": 0,
+        "flaxdiff_tpu/data/prefetch.py": 0,
+        "flaxdiff_tpu/data/online_loader.py": 0,
+        "flaxdiff_tpu/data/dataloaders.py": 0,
+        "flaxdiff_tpu/data/sharded_source.py": 0,
+        "flaxdiff_tpu/data/packed_records.py": 0,
+        # pre-existing decode-path numpy in the media sources,
+        # grandfathered at current counts (host-resident pixel
+        # buffers, not device syncs — candidates for the seam later)
+        "flaxdiff_tpu/data/sources/images.py": 4,
+        "flaxdiff_tpu/data/sources/av.py": 3,
+        "flaxdiff_tpu/data/sources/videos.py": 1,
     },
     "implicit-reshard": {},
     "metric-name": {},

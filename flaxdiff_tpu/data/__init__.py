@@ -7,6 +7,17 @@ The online HTTP streaming loader mirrors reference data/online_loader.py
 with an injectable fetcher so it is testable offline.
 """
 from .dataloaders import get_dataset_grain, make_batch_iterator
+from .dataplane import (
+    BatchScreen,
+    BreakerBoard,
+    DataPlane,
+    HedgedFetcher,
+    QuarantineJournal,
+    ResumableStream,
+    SourceBreaker,
+    StarvationLadder,
+    batch_digest,
+)
 from .dataset_map import DATASET_REGISTRY, get_dataset, register_dataset
 from .online_loader import OnlineStreamingDataLoader
 from .sources.base import DataAugmenter, DataSource, MediaDataset
@@ -29,6 +40,15 @@ __all__ = [
     "get_dataset_grain",
     "make_batch_iterator",
     "OnlineStreamingDataLoader",
+    "DataPlane",
+    "ResumableStream",
+    "QuarantineJournal",
+    "BreakerBoard",
+    "SourceBreaker",
+    "HedgedFetcher",
+    "StarvationLadder",
+    "BatchScreen",
+    "batch_digest",
     "DATASET_REGISTRY",
     "get_dataset",
     "register_dataset",

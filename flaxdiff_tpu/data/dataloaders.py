@@ -15,6 +15,7 @@ from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 import jax
 import numpy as np
 
+from .dataplane import _host_asarray
 from .sources.base import MediaDataset
 
 
@@ -74,42 +75,133 @@ def fallback_batch(reference_batch: Dict[str, Any]) -> Dict[str, Any]:
     return {k: zero(v) for k, v in reference_batch.items()}
 
 
+class GrainIterator:
+    """Stateful epoch iterator over a GrainLoader — the resumable unit
+    of the deterministic data plane (ISSUE 17).
+
+    Exposes `state_dict()/load_state_dict()` (epoch, in-epoch offset,
+    per-epoch production history) and `seek(cursor)` addressing the
+    stream by GLOBAL batch index. A seek jumps whole epochs for free
+    (each epoch's sampler is rebuilt from `seed + epoch`, so entering
+    an epoch costs nothing) and replay-skips within the target epoch —
+    re-decoding at most one epoch's worth of batches, and reproducing
+    the exact decode/fallback sequence an uninterrupted run saw, which
+    is what makes the replay bit-identical.
+
+    Epoch production counts are recorded as epochs complete so a seek
+    across epochs that produced an off-nominal batch count (a decode
+    failure swallowed before any good batch existed) still lands on the
+    right boundary; past recorded history, epochs are assumed nominal —
+    which holds whenever record-level quarantine (placeholder records,
+    geometry preserved) is on, the production configuration."""
+
+    def __init__(self, loader: "GrainLoader", seed: int = 0):
+        self.loader = loader
+        self.seed = seed
+        self.epoch = 0
+        self.offset = 0                  # batches yielded this epoch
+        self.epoch_counts: list = []     # produced per COMPLETED epoch
+        self.last_good: Optional[Dict[str, Any]] = None
+        self._it = None
+
+    def _epoch_iter(self):
+        return iter(self.loader.make_loader(self.seed + self.epoch))
+
+    def __iter__(self) -> "GrainIterator":
+        return self
+
+    def __next__(self) -> Dict[str, Any]:
+        while True:
+            if self._it is None:
+                self._it = self._epoch_iter()
+            try:
+                batch = to_trainer_batch(_destring(next(self._it)))
+            except StopIteration:
+                if self.offset == 0 and self.last_good is None:
+                    # fewer records than one (drop_remainder) batch: an
+                    # epoch yields nothing and the loop would spin forever
+                    raise ValueError(
+                        "grain epoch produced no batches — dataset "
+                        "smaller than one batch (drop_remainder)? records "
+                        "per process insufficient for the local batch size")
+                self.epoch_counts.append(self.offset)
+                self.epoch += 1
+                self.offset = 0
+                self._it = None
+                continue
+            except Exception:
+                # decode/transform failure: keep the loop fed
+                # (reference dataloaders.py:203-247)
+                if self.last_good is None:
+                    continue
+                batch = fallback_batch(self.last_good)
+            self.last_good = batch
+            self.offset += 1
+            return batch
+
+    @property
+    def cursor(self) -> int:
+        """Global batch index of the NEXT batch."""
+        return sum(self.epoch_counts) + self.offset
+
+    def seek(self, cursor: int) -> None:
+        """Position so the next batch is global batch index `cursor`."""
+        epoch, remaining = 0, int(cursor)
+        for count in self.epoch_counts:
+            if remaining < count:
+                break
+            remaining -= count
+            epoch += 1
+        else:
+            bpe = max(self.loader.batches_per_epoch, 1)
+            epoch += remaining // bpe
+            remaining %= bpe
+        self.epoch = epoch
+        self.epoch_counts = self.epoch_counts[:epoch]
+        self.offset = 0
+        self.last_good = None
+        self._it = self._epoch_iter()
+        for _ in range(remaining):       # replay-skip inside the epoch
+            next(self)
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"seed": self.seed, "epoch": self.epoch,
+                "offset": self.offset,
+                "epoch_counts": list(self.epoch_counts)}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.seed = sd.get("seed", self.seed)
+        self.epoch_counts = list(sd.get("epoch_counts", ()))
+        self.epoch = int(sd.get("epoch", 0))
+        self.epoch_counts = self.epoch_counts[:self.epoch]
+        self.offset = 0
+        self.last_good = None
+        self._it = self._epoch_iter()
+        for _ in range(int(sd.get("offset", 0))):
+            next(self)
+
+
 @dataclasses.dataclass
 class GrainLoader:
     """Restartable epoch iterator over a grain DataLoader. Batches come
-    out in trainer contract form ({"sample": ..., "cond"/"text": ...})."""
+    out in trainer contract form ({"sample": ..., "cond"/"text": ...}).
+    Calling it returns a `GrainIterator` (a normal iterator, plus
+    `seek`/`state_dict` for the deterministic data plane)."""
 
     make_loader: Callable[[int], Any]     # seed -> grain DataLoader
     batches_per_epoch: int
 
-    def __call__(self, seed: int = 0) -> Iterator[Dict[str, Any]]:
-        last_good: Optional[Dict[str, Any]] = None
-        epoch = 0
-        while True:
-            it = iter(self.make_loader(seed + epoch))
-            produced = 0
-            while True:
-                try:
-                    batch = to_trainer_batch(_destring(next(it)))
-                except StopIteration:
-                    break
-                except Exception:
-                    # decode/transform failure: keep the loop fed
-                    # (reference dataloaders.py:203-247)
-                    if last_good is None:
-                        continue
-                    batch = fallback_batch(last_good)
-                last_good = batch
-                produced += 1
-                yield batch
-            if produced == 0 and last_good is None:
-                # fewer records than one (drop_remainder) batch: an
-                # epoch yields nothing and the loop would spin forever
-                raise ValueError(
-                    "grain epoch produced no batches — dataset smaller "
-                    "than one batch (drop_remainder)? records per "
-                    f"process insufficient for the local batch size")
-            epoch += 1
+    def __call__(self, seed: int = 0) -> GrainIterator:
+        return GrainIterator(self, seed=seed)
+
+    def iter_from(self, seed: int = 0, cursor: int = 0) -> GrainIterator:
+        """Iterator positioned at global batch index `cursor` — the
+        restart/rollback entry point (`ResumableStream` uses the
+        iterator's own `seek` when rewinding in place)."""
+        it = GrainIterator(self, seed=seed)
+        if cursor:
+            it.seek(cursor)
+        return it
 
 
 def get_dataset_grain(dataset: MediaDataset,
@@ -228,7 +320,7 @@ def make_batch_iterator(images: np.ndarray,
     n = len(images)
     while True:
         idx = rng.integers(0, n, size=batch_size)
-        batch = {"sample": np.asarray(images[idx])}
+        batch = {"sample": _host_asarray(images[idx])}
         if labels is not None:
             batch["text"] = [labels[i] for i in idx]
         yield batch

@@ -1,0 +1,690 @@
+"""Deterministic, fault-tolerant data plane (ISSUE 17).
+
+The rest of the stack (PR 12/15/16) is built on bit-exact replay: a
+rollback or elastic shrink rewinds *params* to the consensus step and
+replays. Until now the data iterators kept advancing through every
+rewind, so replayed steps silently saw different batches. This module
+closes that hole:
+
+- `ResumableStream` / `DataPlane` — cursor-addressed batch streams with
+  `state_dict()/load_state_dict()/seek(cursor)`, committed through the
+  `StepLedger` beside model checkpoints (`record_data_state`), so
+  restart, `anomaly_action=rollback`, and elastic shrink/readmit all
+  rewind the stream to the exact batch boundary.
+- `QuarantineJournal` — undecodable/wrong-shape/non-finite records
+  become deterministic skips recorded with provenance (source, key,
+  reason). The journal is part of iterator state, so replay and
+  late-joining elastic peers agree on what was skipped.
+- `SourceBreaker`/`BreakerBoard` — per-source circuit breakers for the
+  online loader (error-EWMA trips open -> poll-counted cooldown with
+  half-open probes -> reweighting across surviving sources). Cooldowns
+  are counted in *polls*, not wall time, so breaker decisions replay
+  deterministically.
+- `HedgedFetcher` — p99-triggered hedged fetch (the
+  `serving/frontdoor.py` mold): past the latency percentile a duplicate
+  fetch launches; first arm wins. Hedging changes latency only, never
+  values, so determinism is unaffected.
+- `StarvationLadder` — escalation beyond the binary warn|raise:
+  fallback -> degraded -> raise, with deterministic rung thresholds.
+- `BatchScreen` — pre-upload shape/dtype/finite screen for
+  `prefetch_to_device`: a poisoned batch is quarantined and skipped
+  with blast radius one batch, never the step loop.
+- `batch_digest` + `DataPlane.commit` — cross-host batch-hash vote at
+  commit boundaries; divergence surfaces as a typed `data.skew` event
+  instead of unexplained training drift.
+
+Fault sites polled here: `data.poison` (BatchScreen), `data.skew`
+(commit vote). `data.decode` is polled by the record sources
+(packed_records/sharded_source/online_loader).
+
+Everything here is host-side control plane: explicit ZERO host-sync
+budget pins (analysis/budgets.py). The one numpy materialization the
+digest needs goes through the `_host_asarray` seam below.
+"""
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..resilience import events as _res_events
+from ..resilience import faults as _res_faults
+from ..telemetry import global_telemetry as _telemetry
+
+
+def _host_asarray(x) -> np.ndarray:
+    """BLESSED host-sync seam (analysis/ast_rules.py): the data plane's
+    only host materialization point. Batches here are host-resident
+    numpy already — this never forces a device transfer on the step
+    path — but routing through one named seam keeps the data/ tree at
+    zero budget and countable under the counting-mock tests."""
+    return np.asarray(x)
+
+
+def _leaves(tree: Any, prefix: str = "") -> Iterator[Tuple[str, Any]]:
+    """Deterministic (sorted-key) leaf walk over a batch pytree."""
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            yield from _leaves(tree[k], f"{prefix}/{k}" if prefix else str(k))
+    elif isinstance(tree, (list, tuple)) and tree \
+            and isinstance(tree[0], (dict, list, tuple)):
+        for i, v in enumerate(tree):
+            yield from _leaves(v, f"{prefix}[{i}]")
+    else:
+        yield prefix, tree
+
+
+def batch_digest(batch: Any) -> int:
+    """Order-stable crc32 over every leaf's bytes — the value two hosts
+    compare in the commit-boundary skew vote. Strings hash by utf-8,
+    arrays by raw buffer (dtype+shape prefixed so a reshaped identical
+    buffer still differs)."""
+    crc = 0
+    for path, leaf in _leaves(batch):
+        crc = zlib.crc32(path.encode(), crc)
+        if isinstance(leaf, (str, bytes)):
+            data = leaf.encode() if isinstance(leaf, str) else leaf
+            crc = zlib.crc32(data, crc)
+        elif isinstance(leaf, (list, tuple)):
+            for s in leaf:
+                crc = zlib.crc32(str(s).encode(), crc)
+        elif leaf is not None:
+            arr = _host_asarray(leaf)
+            crc = zlib.crc32(str((arr.dtype, arr.shape)).encode(), crc)
+            crc = zlib.crc32(np.ascontiguousarray(arr).view(np.uint8), crc)
+    return crc & 0xFFFFFFFF
+
+
+class QuarantineJournal:
+    """Provenance journal of bad records turned into deterministic skips.
+
+    One entry per unique (source, key): replaying a stream re-encounters
+    the same bad record and must not double-count it, and a late-joining
+    elastic peer loading this state agrees with the survivors on exactly
+    which records were quarantined. Thread-safe (the online loader notes
+    from worker threads)."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._entries: List[Dict[str, Any]] = []
+        self._seen: set = set()
+
+    def note(self, source: str, key: str, reason: str) -> bool:
+        """Record a quarantined record; returns True when NEW (first
+        sighting), False on a replay re-encounter."""
+        with self._lock:
+            ident = (str(source), str(key))
+            if ident in self._seen:
+                return False
+            self._seen.add(ident)
+            entry = {"seq": len(self._entries), "source": str(source),
+                     "key": str(key), "reason": str(reason)}
+            if len(self._entries) < self.capacity:
+                self._entries.append(entry)
+        tel = _telemetry()
+        tel.counter("data/quarantined").inc()
+        tel.write_record({"type": "data_quarantine", **entry})
+        _res_events.record_event(
+            "quarantine", "data.quarantine",
+            detail=f"{source}:{key}: {reason}")
+        return True
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._seen)
+
+    def entries(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._entries)
+
+    def state_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"entries": [dict(e) for e in self._entries]}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        with self._lock:
+            self._entries = [dict(e) for e in state.get("entries", ())]
+            self._seen = {(e["source"], e["key"]) for e in self._entries}
+
+
+def placeholder_record(image_size: int = 8,
+                       channels: int = 3) -> Dict[str, Any]:
+    """The deterministic stand-in a quarantined record decodes to.
+    Keeping batch geometry identical (a zero image, empty caption) is
+    what makes quarantine replay-safe: every host, on every replay,
+    sees the same placeholder in the same slot."""
+    return {"image": np.zeros((image_size, image_size, channels),
+                              dtype=np.uint8),
+            "text": ""}
+
+
+# Breaker states (stringly so state_dict round-trips through JSON)
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+
+class SourceBreaker:
+    """Per-source circuit breaker with deterministic, poll-counted
+    cooldowns.
+
+    EWMA of the error indicator trips the breaker OPEN once at least
+    `min_samples` outcomes were seen and the EWMA crosses `threshold`.
+    While OPEN, `allow()` refuses for `cooldown` polls, then transitions
+    to HALF_OPEN and admits `probes` trial fetches: all-good closes the
+    breaker, any failure re-opens it. Counting polls instead of wall
+    time keeps the decision sequence a pure function of the
+    record/outcome sequence — replay reproduces it bit-for-bit."""
+
+    def __init__(self, name: str, threshold: float = 0.5,
+                 alpha: float = 0.2, min_samples: int = 5,
+                 cooldown: int = 32, probes: int = 2):
+        self.name = name
+        self.threshold = float(threshold)
+        self.alpha = float(alpha)
+        self.min_samples = int(min_samples)
+        self.cooldown = int(cooldown)
+        self.probes = int(probes)
+        self.state = CLOSED
+        self.ewma = 0.0
+        self.samples = 0
+        self.cooldown_left = 0
+        self.probes_left = 0
+        self.trips = 0
+
+    # -- decisions -----------------------------------------------------------
+    def allow(self) -> bool:
+        """One poll: may this fetch proceed?"""
+        if self.state == CLOSED:
+            return True
+        if self.state == OPEN:
+            self.cooldown_left -= 1
+            if self.cooldown_left > 0:
+                _telemetry().counter("data/breaker_skips").inc()
+                return False
+            self._transition(HALF_OPEN)
+            self.probes_left = self.probes
+        # HALF_OPEN: admit probe fetches only
+        if self.probes_left > 0:
+            self.probes_left -= 1
+            _telemetry().counter("data/breaker_probes").inc()
+            return True
+        _telemetry().counter("data/breaker_skips").inc()
+        return False
+
+    def record_ok(self) -> None:
+        self.samples += 1
+        self.ewma = (1 - self.alpha) * self.ewma
+        if self.state == HALF_OPEN and self.probes_left == 0:
+            # every probe came back clean -> close and forgive history
+            self.ewma = 0.0
+            self._transition(CLOSED)
+
+    def record_error(self) -> None:
+        self.samples += 1
+        self.ewma = (1 - self.alpha) * self.ewma + self.alpha
+        if self.state == HALF_OPEN:
+            self._trip()                       # a failed probe re-opens
+        elif self.state == CLOSED and self.samples >= self.min_samples \
+                and self.ewma >= self.threshold:
+            self._trip()
+
+    def _trip(self) -> None:
+        self.trips += 1
+        self.cooldown_left = self.cooldown
+        _telemetry().counter("data/breaker_trips").inc()
+        self._transition(OPEN)
+
+    def _transition(self, state: str) -> None:
+        if state == self.state:
+            return
+        self.state = state
+        tel = _telemetry()
+        tel.write_record({"type": "data_breaker", "source": self.name,
+                          "state": state, "ewma": round(self.ewma, 4),
+                          "trips": self.trips})
+        _res_events.record_event(
+            "breaker", "data.fetch", detail=f"{self.name}:{state}")
+
+    # -- state ---------------------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        return {"state": self.state, "ewma": self.ewma,
+                "samples": self.samples, "cooldown_left": self.cooldown_left,
+                "probes_left": self.probes_left, "trips": self.trips}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.state = sd.get("state", CLOSED)
+        self.ewma = float(sd.get("ewma", 0.0))
+        self.samples = int(sd.get("samples", 0))
+        self.cooldown_left = int(sd.get("cooldown_left", 0))
+        self.probes_left = int(sd.get("probes_left", 0))
+        self.trips = int(sd.get("trips", 0))
+
+
+class BreakerBoard:
+    """Breakers keyed by source name + the reweighting view across
+    survivors. Thread-safe creation; per-breaker calls are GIL-atomic
+    enough for counters (the loader serializes per-record decisions)."""
+
+    def __init__(self, **breaker_kwargs):
+        self._kwargs = breaker_kwargs
+        self._lock = threading.Lock()
+        self._breakers: Dict[str, SourceBreaker] = {}
+
+    def for_source(self, name: str) -> SourceBreaker:
+        with self._lock:
+            br = self._breakers.get(name)
+            if br is None:
+                br = self._breakers[name] = SourceBreaker(
+                    name, **self._kwargs)
+            return br
+
+    def allow(self, name: str) -> bool:
+        return self.for_source(name).allow()
+
+    def record(self, name: str, ok: bool) -> None:
+        br = self.for_source(name)
+        (br.record_ok if ok else br.record_error)()
+
+    def open_sources(self) -> List[str]:
+        with self._lock:
+            return sorted(n for n, b in self._breakers.items()
+                          if b.state != CLOSED)
+
+    def weights(self) -> Dict[str, float]:
+        """Relative fetch weights across sources: an OPEN source weighs
+        0, survivors split its share evenly (renormalized)."""
+        with self._lock:
+            names = sorted(self._breakers)
+            if not names:
+                return {}
+            raw = {n: (0.0 if self._breakers[n].state == OPEN else 1.0)
+                   for n in names}
+        total = sum(raw.values())
+        if total == 0:
+            return {n: 0.0 for n in raw}
+        return {n: v / total for n, v in raw.items()}
+
+    def state_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            return {n: b.state_dict() for n, b in self._breakers.items()}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        for name, st in sd.items():
+            self.for_source(name).load_state_dict(st)
+
+
+class HedgedFetcher:
+    """p99-triggered hedged fetch (the serving/frontdoor.py mold).
+
+    Wraps a `fetcher(url) -> bytes`. Once `min_observations` latencies
+    are on the window, a fetch that outlives the rolling `percentile`
+    cutoff launches ONE duplicate; whichever arm finishes first wins
+    and the result is returned (both arms fetch the same URL, so the
+    value — and therefore replay determinism — is unaffected; only the
+    tail latency changes). The loser is abandoned, not cancelled:
+    urllib has no cancellation, and a daemon thread holding a dead
+    socket is cheaper than a stuck batch."""
+
+    def __init__(self, fetcher: Callable[[str], bytes],
+                 percentile: float = 0.99, min_observations: int = 20,
+                 window: int = 256, max_wait: float = 30.0):
+        self.fetcher = fetcher
+        self.percentile = float(percentile)
+        self.min_observations = int(min_observations)
+        self.max_wait = float(max_wait)
+        self._lock = threading.Lock()
+        self._window = int(window)
+        self._lat: List[float] = []
+
+    def _cutoff(self) -> Optional[float]:
+        with self._lock:
+            if len(self._lat) < self.min_observations:
+                return None
+            xs = sorted(self._lat)
+        # nearest-rank percentile, no numpy (frontdoor idiom)
+        rank = max(int(self.percentile * len(xs) + 0.999999) - 1, 0)
+        return xs[min(rank, len(xs) - 1)]
+
+    def _observe(self, dt: float) -> None:
+        with self._lock:
+            self._lat.append(dt)
+            if len(self._lat) > self._window:
+                self._lat = self._lat[-self._window:]
+        _telemetry().histogram("data/fetch_ms").observe(dt * 1e3)
+
+    def __call__(self, url: str) -> bytes:
+        import time as _time
+        cutoff = self._cutoff()
+        done = threading.Event()
+        slots: List[Any] = []
+        slot_lock = threading.Lock()
+
+        def arm():
+            t0 = _time.monotonic()
+            try:
+                out = self.fetcher(url)
+            except BaseException as e:  # noqa: BLE001 — relayed below
+                out = e
+            else:
+                self._observe(_time.monotonic() - t0)
+            with slot_lock:
+                slots.append(out)
+            done.set()
+
+        t = threading.Thread(target=arm, daemon=True,
+                             name="flaxdiff-fetch-primary")
+        t.start()
+        if cutoff is not None and not done.wait(cutoff):
+            _telemetry().counter("data/fetch_hedges").inc()
+            t2 = threading.Thread(target=arm, daemon=True,
+                                  name="flaxdiff-fetch-hedge")
+            t2.start()
+            done.wait(self.max_wait)
+            with slot_lock:
+                if slots and not t.is_alive():
+                    pass                       # primary finished anyway
+                elif slots:
+                    _telemetry().counter("data/fetch_hedge_wins").inc()
+        else:
+            done.wait(self.max_wait)
+        with slot_lock:
+            if not slots:
+                raise TimeoutError(
+                    f"hedged fetch exceeded max_wait={self.max_wait}s: "
+                    f"{url}")
+            first = slots[0]
+        if isinstance(first, BaseException):
+            raise first
+        return first
+
+
+class StarvationLadder:
+    """Escalation ladder for loader starvation — beyond the binary
+    warn|raise. Consecutive starved batches climb rungs:
+
+        1..degrade_after-1   -> "fallback"  (zero batch, keep going)
+        degrade_after..raise_after-1 -> "degrade" (fallback + typed
+                                         degraded event: the run is
+                                         visibly limping, page-able)
+        raise_after..         -> "raise"    (fail fast)
+
+    A single good batch resets the ladder. Thresholds are counts of
+    consecutive starvations — deterministic given the batch sequence."""
+
+    def __init__(self, degrade_after: int = 3, raise_after: int = 8):
+        if not 0 < degrade_after < raise_after:
+            raise ValueError("need 0 < degrade_after < raise_after")
+        self.degrade_after = int(degrade_after)
+        self.raise_after = int(raise_after)
+        self.streak = 0
+
+    def observe_starved(self) -> str:
+        self.streak += 1
+        if self.streak >= self.raise_after:
+            rung = "raise"
+        elif self.streak >= self.degrade_after:
+            rung = "degrade"
+        else:
+            rung = "fallback"
+        if rung != "fallback":
+            _telemetry().counter("data/starvation_escalations").inc()
+            _res_events.record_event(
+                "starvation_escalated", "data.starved",
+                detail=f"{rung} after {self.streak} consecutive")
+        return rung
+
+    def observe_ok(self) -> None:
+        self.streak = 0
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"streak": self.streak}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.streak = int(sd.get("streak", 0))
+
+
+class BatchScreen:
+    """Pre-upload batch screen: shape/dtype/finite check run by
+    `prefetch_to_device` BEFORE the H2D put. Returns a reason string
+    for a poisoned batch (quarantine + skip, blast radius one batch)
+    or None for a clean one. Geometry is locked to the first batch
+    seen — a later drift is a poisoning, not a new normal."""
+
+    def __init__(self, check_finite: bool = True):
+        self.check_finite = bool(check_finite)
+        self.reference: Optional[Dict[str, Tuple]] = None
+        self.screened = 0
+
+    def __call__(self, batch: Any) -> Optional[str]:
+        self.screened += 1
+        if _res_faults.check("data.poison"):
+            return "injected: data.poison"
+        geom: Dict[str, Tuple] = {}
+        for path, leaf in _leaves(batch):
+            if not isinstance(leaf, np.ndarray):
+                continue
+            geom[path] = (leaf.shape, str(leaf.dtype))
+            if self.check_finite \
+                    and np.issubdtype(leaf.dtype, np.floating) \
+                    and not np.isfinite(leaf).all():
+                return f"non-finite values in {path or 'batch'}"
+        if self.reference is None:
+            self.reference = geom
+        elif geom != self.reference:
+            drift = sorted(set(geom) ^ set(self.reference)) or sorted(
+                p for p in geom if geom[p] != self.reference.get(p))
+            return f"geometry drift at {', '.join(drift[:4])}"
+        return None
+
+    def state_dict(self) -> Dict[str, Any]:
+        ref = None
+        if self.reference is not None:
+            ref = {p: [list(s), d] for p, (s, d) in self.reference.items()}
+        return {"screened": self.screened, "reference": ref}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.screened = int(sd.get("screened", 0))
+        ref = sd.get("reference")
+        self.reference = None if ref is None else {
+            p: (tuple(s), d) for p, (s, d) in ref.items()}
+
+
+class ResumableStream:
+    """Cursor-addressed wrapper over a batch-iterator factory.
+
+    `factory` is either a callable `seed -> iterator` (e.g. a
+    `GrainLoader`) or a plain iterable. When the produced iterator
+    exposes `seek(cursor)` (GrainLoader's `GrainIterator`), rewinds use
+    it — epoch-jump + bounded replay-skip; otherwise the stream is
+    rebuilt from scratch and `cursor` batches are drained (correct, but
+    O(cursor) — fine for tests and in-memory iterators).
+
+    NOT thread-safe against a live consumer: callers must stop/close
+    the downstream prefetcher before `seek`/`load_state_dict` (the
+    trainer closes its `prefetch_to_device` first)."""
+
+    def __init__(self, factory: Any, seed: int = 0):
+        self.factory = factory
+        self.seed = int(seed)
+        self.cursor = 0
+        self._it: Optional[Iterator] = None
+
+    def _open(self) -> Iterator:
+        f = self.factory
+        return f(self.seed) if callable(f) else iter(f)
+
+    def __iter__(self) -> "ResumableStream":
+        return self
+
+    def __next__(self) -> Any:
+        if self._it is None:
+            self._it = self._open()
+        batch = next(self._it)
+        self.cursor += 1
+        return batch
+
+    def seek(self, cursor: int) -> None:
+        cursor = int(cursor)
+        if self._it is not None and hasattr(self._it, "seek"):
+            self._it.seek(cursor)
+        else:
+            self._it = self._open()
+            if hasattr(self._it, "seek"):
+                self._it.seek(cursor)
+            else:
+                for _ in range(cursor):
+                    next(self._it)
+        self.cursor = cursor
+
+    def state_dict(self) -> Dict[str, Any]:
+        sd: Dict[str, Any] = {"seed": self.seed, "cursor": self.cursor}
+        if self._it is not None and hasattr(self._it, "state_dict"):
+            sd["inner"] = self._it.state_dict()
+        return sd
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.seed = int(sd.get("seed", self.seed))
+        self.seek(int(sd.get("cursor", 0)))
+
+
+class DataPlane:
+    """The trainer-facing bundle: resumable stream + quarantine journal
+    + breaker board + pre-upload screen + commit-boundary skew vote.
+
+    Wire into `DiffusionTrainer.fit(data_plane=...)`: the trainer
+    consumes `iter(plane)`, hands `plane.screen` to
+    `prefetch_to_device`, calls `plane.commit(step, ledger)` after each
+    checkpoint commit, and `plane.seek(step)` after each rollback —
+    rebuilding the prefetcher so prefetched-but-unconsumed batches are
+    discarded rather than replayed out of order."""
+
+    DIGEST_RING = 128
+
+    def __init__(self, factory: Any, seed: int = 0,
+                 journal: Optional[QuarantineJournal] = None,
+                 breakers: Optional[BreakerBoard] = None,
+                 screen: Optional[BatchScreen] = None,
+                 transport: Any = None):
+        self.stream = ResumableStream(factory, seed=seed)
+        self.journal = journal if journal is not None else QuarantineJournal()
+        self.breakers = breakers
+        self.screen = screen if screen is not None else BatchScreen()
+        self.transport = transport
+        self.rewinds = 0
+        # per-commit vote round counter: every host runs the same commit
+        # sequence, so the round number is itself deterministic and the
+        # allgather rendezvous names can never collide across commits
+        # (even when a rollback re-reaches an already-voted step)
+        self._vote_round = 0
+        # batch-index -> digest ring: commit looks up the digest of the
+        # last CONSUMED batch (index step-1), which is always <= the
+        # prefetch high-water cursor, so it is always on the ring
+        self._digests: Dict[int, int] = {}
+
+    # -- iteration -----------------------------------------------------------
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        idx = self.stream.cursor           # 0-based index of this batch
+        batch = next(self.stream)
+        self._digests[idx] = batch_digest(batch)
+        stale = idx - self.DIGEST_RING
+        if stale in self._digests:
+            del self._digests[stale]
+        _telemetry().counter("data/batches_out").inc()
+        return batch
+
+    # -- rewind --------------------------------------------------------------
+    def seek(self, step: int) -> None:
+        """Position the stream so the NEXT batch is batch index `step`
+        (step N+1 consumes batch N: after a rollback to committed step
+        S, replay resumes at batch S)."""
+        self.rewinds += 1
+        _telemetry().counter("data/stream_rewinds").inc()
+        self.stream.seek(step)
+        # drop digests past the rewind point: replay recomputes them
+        # (and MUST reproduce them — that is the bit-exact contract)
+        for idx in [i for i in self._digests if i >= step]:
+            del self._digests[idx]
+
+    # -- state ---------------------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        sd = {"stream": self.stream.state_dict(),
+              "journal": self.journal.state_dict(),
+              "screen": self.screen.state_dict()}
+        if self.breakers is not None:
+            sd["breakers"] = self.breakers.state_dict()
+        return sd
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.journal.load_state_dict(sd.get("journal", {}))
+        self.screen.load_state_dict(sd.get("screen", {}))
+        if self.breakers is not None and "breakers" in sd:
+            self.breakers.load_state_dict(sd["breakers"])
+        self.stream.load_state_dict(sd.get("stream", {}))
+
+    def adopt(self, factory: Any, cursor: int) -> None:
+        """Elastic world change: swap to the resharded factory and seek
+        to the consensus step's batch boundary — the surviving view
+        starts past everything already consumed, so a shrink never
+        re-serves replayed samples out of order."""
+        self.stream = ResumableStream(factory, seed=self.stream.seed)
+        self.seek(cursor)
+
+    # -- commit boundary -----------------------------------------------------
+    def commit(self, step: int, ledger: Any = None) -> bool:
+        """Commit-boundary hook: persist data-plane state beside the
+        model checkpoint and run the cross-host batch-hash vote.
+        Returns True when every host agreed on the digest (solo runs
+        trivially agree)."""
+        step = int(step)
+        digest = self._digests.get(step - 1, 0)
+        if _res_faults.check("data.skew", step=step):
+            digest = (digest ^ 0x5EED) & 0xFFFFFFFF
+        agreed = True
+        world = 1
+        if self.transport is not None:
+            self._vote_round += 1
+            rows = self.transport.allgather_json(
+                f"data_skew/{self._vote_round}",
+                {"step": step, "digest": digest}, 30.0)
+            world = len(rows)
+            agreed = len({r.get("digest") for r in rows}) <= 1
+        tel = _telemetry()
+        tel.counter("data/skew_votes").inc()
+        tel.write_record({"type": "data_skew", "step": step,
+                          "digest": digest, "world": world,
+                          "agreed": agreed})
+        if not agreed:
+            tel.counter("data/skew_detected").inc()
+            _res_events.record_event(
+                "data_skew", "data.skew",
+                detail=f"batch digest mismatch at commit step {step}",
+                step=step)
+        state = {"cursor": step, "seed": self.stream.seed,
+                 "journal": self.journal.state_dict(),
+                 "screen": self.screen.state_dict()}
+        if self.breakers is not None:
+            state["breakers"] = self.breakers.state_dict()
+        if ledger is not None:
+            ledger.record_data_state(step, state)
+        return agreed
+
+    def restore(self, step: int, ledger: Any = None) -> None:
+        """Restart path: load the newest data_state entry at or below
+        `step` from the ledger (if any), then seek to `step`'s batch
+        boundary. Without a ledger entry this degrades to a plain
+        seek — the journal starts empty and repopulates on replay."""
+        state = None
+        if ledger is not None and hasattr(ledger, "data_state_at"):
+            state = ledger.data_state_at(step)
+        if state is not None:
+            self.journal.load_state_dict(state.get("journal", {}))
+            self.screen.load_state_dict(state.get("screen", {}))
+            if self.breakers is not None and "breakers" in state:
+                self.breakers.load_state_dict(state["breakers"])
+            self.stream.seed = int(state.get("seed", self.stream.seed))
+        self.seek(step)

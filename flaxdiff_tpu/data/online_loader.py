@@ -21,6 +21,13 @@ from ..resilience import faults as _res_faults
 from ..resilience.retry import RetryPolicy
 from ..telemetry import global_telemetry as _telemetry
 from .dataloaders import collate, fallback_batch
+from .dataplane import (
+    BreakerBoard,
+    HedgedFetcher,
+    QuarantineJournal,
+    StarvationLadder,
+    _host_asarray,
+)
 
 
 class _SliceView:
@@ -63,6 +70,19 @@ class _EpochSampler:
             self.pos += 1
             return i
 
+    def state_dict(self) -> Dict[str, int]:
+        with self.lock:
+            return {"epoch": self.epoch, "pos": self.pos}
+
+    def load_state_dict(self, sd: Dict[str, int]) -> None:
+        """Rewind/advance to an exact (epoch, pos): the permutation is a
+        pure function of seed+epoch, so position alone is the state."""
+        with self.lock:
+            self.epoch = int(sd.get("epoch", 0))
+            self.pos = int(sd.get("pos", 0))
+            self.perm = np.random.default_rng(
+                self.seed + self.epoch).permutation(self.n)
+
 
 def make_clip_similarity_filter(threshold: float = 0.25,
                                 modelname: str =
@@ -79,7 +99,7 @@ def make_clip_similarity_filter(threshold: float = 0.25,
         if "text" not in sample:
             return True
         inputs = processor(text=[str(sample["text"])],
-                           images=[np.asarray(sample["image"])],
+                           images=[_host_asarray(sample["image"])],
                            return_tensors="np", padding=True)
         out = model(**inputs)
         img = out.image_embeds / jnp.linalg.norm(out.image_embeds)
@@ -87,6 +107,29 @@ def make_clip_similarity_filter(threshold: float = 0.25,
         return float((img * txt).sum()) >= threshold
 
     return keep
+
+
+def retry_after_floor(exc: BaseException) -> Optional[float]:
+    """Server-directed backoff floor for throttling responses.
+
+    HTTP 429 (Too Many Requests) and 503 (Service Unavailable) are
+    retryable-with-backoff, and when the server names its own cooldown
+    via a `Retry-After` header (delta-seconds form), retrying sooner
+    just burns budget against a closed door. Returns that floor in
+    seconds, or None when the error carries no throttling directive
+    (HTTP-date form and absent headers fall back to the policy's
+    exponential schedule)."""
+    code = getattr(exc, "code", None)
+    if code not in (429, 503):
+        return None
+    headers = getattr(exc, "headers", None)
+    raw = headers.get("Retry-After") if headers is not None else None
+    if raw is None:
+        return None
+    try:
+        return max(float(str(raw).strip()), 0.0)
+    except ValueError:
+        return None     # HTTP-date form: policy schedule applies
 
 
 def default_url_fetcher(timeout: float = 10.0,
@@ -100,13 +143,17 @@ def default_url_fetcher(timeout: float = 10.0,
 
     Exponential backoff + jitter between attempts; non-retryable HTTP
     client errors (404, 403, ...) propagate after ONE attempt via the
-    policy's classifier. `policy` overrides the default (then `retries`
-    is ignored); `opener` substitutes urllib.request.urlopen in tests.
+    policy's classifier. Throttling responses (429/503) are retryable
+    AND honor the server's `Retry-After` header as a backoff floor
+    (`retry_after_floor`). `policy` overrides the default (then
+    `retries` is ignored); `opener` substitutes urllib.request.urlopen
+    in tests.
     """
     import urllib.request
     open_ = opener if opener is not None else urllib.request.urlopen
     pol = policy if policy is not None else RetryPolicy(
-        max_attempts=retries + 1, base_delay=0.1, max_delay=2.0)
+        max_attempts=retries + 1, base_delay=0.1, max_delay=30.0,
+        delay_floor_from=retry_after_floor)
 
     def attempt(url: str) -> bytes:
         # key=url: per_key fault specs schedule deterministically PER
@@ -156,7 +203,10 @@ class OnlineStreamingDataLoader:
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None,
                  seed: int = 0,
-                 starvation_action: str = "warn"):
+                 starvation_action: str = "warn",
+                 quarantine: Optional[QuarantineJournal] = None,
+                 breakers: Optional[BreakerBoard] = None,
+                 hedge: Optional[Dict[str, Any]] = None):
         import jax
         pi = jax.process_index() if process_index is None else process_index
         pc = jax.process_count() if process_count is None else process_count
@@ -168,19 +218,32 @@ class OnlineStreamingDataLoader:
         self.min_image_size = min_image_size
         self.timeout = timeout
         self.fetcher = fetcher or default_url_fetcher()
+        if hedge is not None:
+            # p99-triggered hedged fetch (dataplane.HedgedFetcher): past
+            # the rolling latency percentile a duplicate fetch launches;
+            # first arm wins. Values are unchanged, only tail latency.
+            self.fetcher = HedgedFetcher(self.fetcher, **hedge)
         self.filter_fn = filter_fn
         self.queue: "queue.Queue" = queue.Queue(maxsize=queue_size)
         self.num_threads = num_threads
         self.seed = seed
-        if starvation_action not in ("warn", "raise"):
+        if starvation_action not in ("warn", "raise", "escalate"):
             raise ValueError(
-                f"starvation_action must be 'warn' or 'raise', "
-                f"got {starvation_action!r}")
+                f"starvation_action must be 'warn', 'raise' or "
+                f"'escalate', got {starvation_action!r}")
         # "warn": starved rounds yield a zero fallback batch (reference
         # dummy-injection semantics) and record a `starvation` event each
         # time. "raise": fail fast — production runs must not silently
-        # train on filler batches.
+        # train on filler batches. "escalate": climb the
+        # StarvationLadder — fallback, then typed `degrade` events,
+        # then raise — so a limping pipeline pages before it kills.
         self.starvation_action = starvation_action
+        self._ladder = (StarvationLadder()
+                        if starvation_action == "escalate" else None)
+        # bad-record quarantine + per-source circuit breakers (ISSUE 17):
+        # both optional, both part of resumable state when present
+        self.quarantine = quarantine
+        self.breakers = breakers
         self._sampler = _EpochSampler(max(len(self.records), 1), seed)
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -206,7 +269,7 @@ class OnlineStreamingDataLoader:
                 row = ds[int(i)]
                 rec: Dict[str, Any] = {}
                 if image_key in row:
-                    rec["image"] = np.asarray(row[image_key])
+                    rec["image"] = _host_asarray(row[image_key])
                 elif "url" in row:   # fetch-by-URL datasets (LAION-style)
                     rec["url"] = row["url"]
                 else:
@@ -220,19 +283,32 @@ class OnlineStreamingDataLoader:
         return cls(_Rows(), **kwargs)
 
     # -- workers -------------------------------------------------------------
-    def _load_one(self, record: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    def _load_one(self, record: Dict[str, Any],
+                  key: str = "") -> Optional[Dict[str, Any]]:
         # sample-level counters land on the process-global telemetry hub
         # (worker threads have no plumbing); skip reasons are separated
         # because "filtered by policy" and "failed to fetch/decode" need
         # opposite responses from an operator
         tel = _telemetry()
+        source = str(record.get("source", "default"))
+        fetched = "image" not in record
+        if fetched and self.breakers is not None \
+                and not self.breakers.allow(source):
+            # breaker OPEN: deterministic skip, reweighting the epoch
+            # onto surviving sources (allow() counted the skip)
+            return None
         try:
+            # chaos site: a plan arming "data.decode" poisons this
+            # record's decode deterministically (per_key scheduling)
+            _res_faults.check("data.decode", key=key or source)
             if "image" in record:
                 img = record["image"]
                 img = decode_image(img) if isinstance(img, (bytes, bytearray)) \
-                    else np.asarray(img)
+                    else _host_asarray(img)
             else:
                 img = decode_image(self.fetcher(record["url"]))
+                if self.breakers is not None:
+                    self.breakers.record(source, ok=True)
             img = smart_resize(img, self.image_size, self.min_image_size)
             if img is None:
                 tel.counter("data/samples_filtered").inc()
@@ -245,22 +321,59 @@ class OnlineStreamingDataLoader:
                 return None
             tel.counter("data/samples_ok").inc()
             return out
-        except Exception:
+        except Exception as e:
             tel.counter("data/samples_failed").inc()
+            if fetched and self.breakers is not None:
+                self.breakers.record(source, ok=False)
+            if self.quarantine is not None:
+                self.quarantine.note(
+                    source, key or record.get("url", "<record>"),
+                    f"{type(e).__name__}: {e}")
             return None
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Resumable position: sampler epoch/pos plus quarantine and
+        breaker state. Thread fan-out makes batch COMPOSITION depend on
+        worker timing, so restoring this state resumes at the exact
+        sample frontier (no record re-served, none skipped) — batch
+        bit-exactness is the deterministic grain path's guarantee."""
+        sd: Dict[str, Any] = {"seed": self.seed,
+                              "sampler": self._sampler.state_dict()}
+        if self.quarantine is not None:
+            sd["quarantine"] = self.quarantine.state_dict()
+        if self.breakers is not None:
+            sd["breakers"] = self.breakers.state_dict()
+        return sd
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        if self._started:
+            raise RuntimeError(
+                "load_state_dict before start(): live workers would "
+                "race the sampler rewind")
+        self._sampler.load_state_dict(sd.get("sampler", {}))
+        if self.quarantine is not None and "quarantine" in sd:
+            self.quarantine.load_state_dict(sd["quarantine"])
+        if self.breakers is not None and "breakers" in sd:
+            self.breakers.load_state_dict(sd["breakers"])
 
     def _worker(self, worker_id: int):
         while not self._stop.is_set():
             # chaos site: a plan arming "data.stall" wedges this worker
             # for its configured delay (watchdog coverage)
             _res_faults.maybe_stall("data.stall")
+            idx = self._sampler.next_index()
             try:
                 # record access is inside the fault barrier: lazy views
                 # (_SliceView over HF datasets) can raise on __getitem__
-                record = self.records[self._sampler.next_index()]
-            except Exception:
+                record = self.records[idx]
+            except Exception as e:
+                if self.quarantine is not None:
+                    self.quarantine.note(
+                        "records", f"idx:{idx}",
+                        f"{type(e).__name__}: {e}")
                 continue
-            sample = self._load_one(record)
+            sample = self._load_one(
+                record, key=str(record.get("url", f"idx:{idx}")))
             if sample is None:
                 continue
             while not self._stop.is_set():
@@ -305,6 +418,8 @@ class OnlineStreamingDataLoader:
                 time.monotonic() - t_batch)
             if len(samples) == self.batch_size:
                 empty_rounds = 0
+                if self._ladder is not None:
+                    self._ladder.observe_ok()
                 _telemetry().counter("data/batches").inc()
                 batch = collate(samples)
                 last_good = batch
@@ -314,20 +429,26 @@ class OnlineStreamingDataLoader:
                 # either way; "raise" fails fast instead of silently
                 # training on filler, "warn" keeps the training loop fed
                 # with a zero fallback batch (reference
-                # online_loader.py:673-693 dummy injection).
+                # online_loader.py:673-693 dummy injection),
+                # "escalate" climbs the ladder between the two.
+                action = self.starvation_action
+                if self._ladder is not None:
+                    rung = self._ladder.observe_starved()
+                    action = "raise" if rung == "raise" else "warn"
                 _res_events.record_event(
                     "starvation", "data.loader",
                     detail=f"{len(samples)}/{self.batch_size} samples in "
                            f"{self.timeout}s; "
                            + ("yielding zero fallback batch"
-                              if self.starvation_action == "warn"
+                              if action == "warn"
                               else "failing fast"))
                 _telemetry().counter("data/starved_batches").inc()
-                if self.starvation_action == "raise":
+                if action == "raise":
                     raise RuntimeError(
                         "online loader starved: "
                         f"{len(samples)}/{self.batch_size} samples within "
-                        f"{self.timeout}s (starvation_action='raise')")
+                        f"{self.timeout}s (starvation_action="
+                        f"{self.starvation_action!r})")
                 yield fallback_batch(last_good)
             else:
                 # Nothing ever produced: either the workers died or every

@@ -19,6 +19,7 @@ from typing import Any, Dict, Iterable, List, Optional
 
 import numpy as np
 
+from .dataplane import _host_asarray
 from .sources.base import DataSource
 
 MAGIC = b"FDTR"
@@ -234,19 +235,41 @@ def decode_standard_record(entries: Dict[str, bytes]) -> Dict[str, Any]:
 @dataclasses.dataclass
 class PackedRecordSource(DataSource):
     """DataSource over a packed record file; decodes the standard
-    image/text entries (image bytes via cv2, caption utf-8)."""
+    image/text entries (image bytes via cv2, caption utf-8).
+
+    With a `quarantine` journal, an undecodable/torn record becomes a
+    deterministic placeholder (zero image, empty caption) noted with
+    provenance instead of an exception — same semantics as
+    `ShardedPackedRecordSource` (see its docstring)."""
 
     path: str
+    quarantine: Optional[Any] = None
+    placeholder_size: int = 8
 
     def get_source(self, path_override: Optional[str] = None):
         reader = PackedRecordReader(path_override or self.path)
+        outer = self
 
         class _Src:
             def __len__(self):
                 return len(reader)
 
             def __getitem__(self, i):
-                return decode_standard_record(reader[int(i)])
+                from ..resilience import faults as _res_faults
+                try:
+                    # chaos site: "data.decode" poisons this record
+                    # deterministically (per_key scheduling)
+                    _res_faults.check(
+                        "data.decode", key=f"{outer.path}:{int(i)}")
+                    return decode_standard_record(reader[int(i)])
+                except Exception as e:
+                    if outer.quarantine is None:
+                        raise
+                    outer.quarantine.note(
+                        outer.path, f"rec:{int(i)}",
+                        f"{type(e).__name__}: {e}")
+                    from .dataplane import placeholder_record
+                    return placeholder_record(outer.placeholder_size)
 
         return _Src()
 
@@ -260,7 +283,7 @@ def write_image_dataset(path: str, images: Iterable[np.ndarray],
     with PackedRecordWriter(path) as w:
         for i, img in enumerate(images):
             ok, enc = cv2.imencode(
-                format, cv2.cvtColor(np.asarray(img), cv2.COLOR_RGB2BGR))
+                format, cv2.cvtColor(_host_asarray(img), cv2.COLOR_RGB2BGR))
             if not ok:
                 raise ValueError(f"could not encode image {i}")
             rec = {"image": enc.tobytes()}

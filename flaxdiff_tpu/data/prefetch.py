@@ -102,16 +102,32 @@ class prefetch_to_device:
     in `DiffusionTrainer.fit`). A worker wedged inside the source
     iterator past `join_timeout` is abandoned (daemon) with a
     `pipeline_error`-adjacent warning event rather than hanging the
-    caller's shutdown."""
+    caller's shutdown.
+
+    `screen` (ISSUE 17) is the pre-upload batch screen: called on each
+    HOST batch BEFORE `put_fn` (i.e. before any H2D copy); a non-None
+    reason quarantines the batch (noted in `quarantine` when given) and
+    skips it deterministically — blast radius one batch, never the step
+    loop. `state_dict()` exposes the in-flight window (submitted vs
+    delivered vs screened) so the data plane can account for every
+    batch the pipeline ever touched — the "zero stranded batches"
+    acceptance in `bench.py --data_chaos`."""
 
     def __init__(self, put_fn: Callable[[T], U], it: Iterator[T],
-                 depth: int = 2, join_timeout: float = 5.0):
+                 depth: int = 2, join_timeout: float = 5.0,
+                 screen: Callable[[T], "str | None"] = None,
+                 quarantine=None):
         if depth < 1:
             raise ValueError("depth must be >= 1")
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._join_timeout = join_timeout
         self._done = False
+        # in-flight window accounting (worker writes, consumer reads;
+        # int updates are GIL-atomic enough for bookkeeping)
+        self._submitted = 0     # batches handed to put_fn (post-screen)
+        self._delivered = 0     # batches the consumer received
+        self._screened_out = 0  # batches the screen quarantined
 
         def put(item) -> bool:
             while not self._stop.is_set():
@@ -127,6 +143,23 @@ class prefetch_to_device:
                 for item in it:
                     if self._stop.is_set():
                         return
+                    if screen is not None:
+                        reason = screen(item)
+                        if reason is not None:
+                            self._screened_out += 1
+                            from ..resilience.events import record_event
+                            from ..telemetry import global_telemetry
+                            global_telemetry().counter(
+                                "data/poisoned_batches").inc()
+                            record_event(
+                                "quarantine", "data.poison",
+                                detail=f"pre-upload screen: {reason}")
+                            if quarantine is not None:
+                                seen = self._submitted + self._screened_out
+                                quarantine.note(
+                                    "prefetch", f"batch:{seen}", reason)
+                            continue
+                    self._submitted += 1
                     if not put(put_fn(item)):
                         return
             except BaseException as e:
@@ -154,7 +187,17 @@ class prefetch_to_device:
             if got[1] is not None:
                 raise got[1]
             raise StopIteration
+        self._delivered += 1
         return got
+
+    def state_dict(self) -> dict:
+        """In-flight window snapshot: `submitted - delivered` is the
+        number of uploaded-but-unconsumed batches (bounded by
+        `depth + 1`); after `close()` it is the discarded window."""
+        return {"submitted": self._submitted,
+                "delivered": self._delivered,
+                "screened_out": self._screened_out,
+                "in_flight": self._submitted - self._delivered}
 
     def close(self) -> None:
         """Stop the worker and join it (bounded). Prefetched-but-unread
@@ -168,6 +211,9 @@ class prefetch_to_device:
                 self._q.get_nowait()
         except queue.Empty:
             pass
+        # a post-close next() must fail fast, not block on the drained
+        # queue waiting for a worker that is already gone
+        self._done = True
         self._thread.join(self._join_timeout)
         if self._thread.is_alive():
             from ..resilience.events import record_event

@@ -31,6 +31,7 @@ import zlib
 from collections import OrderedDict
 from typing import Any, List, Optional, Sequence
 
+from ..resilience import faults as _res_faults
 from .sources.base import DataSource
 
 _HEADER = struct.Struct("<4sIQ")          # magic, version, n_records
@@ -114,13 +115,25 @@ class ShardedPackedRecordSource(DataSource):
     filesystem. `filesystem=None` uses the native mmap reader on local
     paths; any FileSystem object switches every shard to the Python
     seek/read path. `max_open` bounds concurrently-open shard readers
-    (LRU eviction)."""
+    (LRU eviction).
+
+    `quarantine` (a `dataplane.QuarantineJournal`): an undecodable or
+    torn record becomes a DETERMINISTIC placeholder (zero image, empty
+    caption — batch geometry preserved) noted with provenance
+    (shard path, local index, reason) instead of an exception. Replay
+    re-encounters the same bad record, decodes to the same placeholder,
+    and the journal dedupes — the bit-exact-replay contract. In-process
+    only: grain worker subprocesses drop the journal on pickle (their
+    quarantines still yield placeholders, but provenance lands in the
+    worker, so the deterministic data plane runs `worker_count=0`)."""
 
     shards: Optional[Sequence[str]] = None
     pattern: Optional[str] = None
     filesystem: Optional[Any] = None
     max_open: int = 16
     decode: bool = True
+    quarantine: Optional[Any] = None
+    placeholder_size: int = 8
 
     def __post_init__(self):
         fs = self.filesystem or LocalFileSystem()
@@ -159,6 +172,10 @@ class ShardedPackedRecordSource(DataSource):
         state = self.__dict__.copy()
         state["_readers"] = OrderedDict()
         state["_lock"] = None
+        # the journal holds a lock and its provenance is only meaningful
+        # in-process (see class docstring): workers decode placeholders
+        # without journaling rather than failing to pickle
+        state["quarantine"] = None
         return state
 
     def __setstate__(self, state):
@@ -217,10 +234,21 @@ class ShardedPackedRecordSource(DataSource):
                 path, local = outer.locate(int(i))
                 from .packed_records import (decode_standard_record,
                                              unpack_record)
-                entries = unpack_record(
-                    outer._reader(path).record_bytes(local))
-                if not outer.decode:
-                    return entries
-                return decode_standard_record(entries)
+                try:
+                    # chaos site: a plan arming "data.decode" corrupts
+                    # this record deterministically (per_key scheduling)
+                    _res_faults.check("data.decode", key=f"{path}:{local}")
+                    entries = unpack_record(
+                        outer._reader(path).record_bytes(local))
+                    if not outer.decode:
+                        return entries
+                    return decode_standard_record(entries)
+                except Exception as e:
+                    if outer.quarantine is None:
+                        raise
+                    outer.quarantine.note(
+                        path, f"rec:{local}", f"{type(e).__name__}: {e}")
+                    from .dataplane import placeholder_record
+                    return placeholder_record(outer.placeholder_size)
 
         return _Src()

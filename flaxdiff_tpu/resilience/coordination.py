@@ -84,6 +84,7 @@ class StepLedger:
         {"kind": "world_changed", "change": "shrink"|"grow"|"evict",
          "epoch": 2, "members": [...], "world": 3, "step": 400, ...}
         {"kind": "quorum", "votes": {...}, "decision": "...", ...}
+        {"kind": "data_state", "step": 400, "state": {...}, "time": ...}
 
     `world_changed` entries are the committed membership history of an
     elastic run (resilience/elastic.py): one entry per transition,
@@ -197,6 +198,29 @@ class StepLedger:
                       "decision": decision,
                       "step": (int(step) if step is not None else None),
                       "detail": detail, "time": time.time()})
+
+    def record_data_state(self, step: int,
+                          state: Dict[str, object]) -> None:
+        """Data-plane iterator state committed beside the model
+        checkpoint (ISSUE 17): stream cursor/seed, quarantine journal,
+        breaker board. Written at the same commit boundary as the
+        `commit` entry, so a restart that restores step S also rewinds
+        the batch stream to S's exact boundary."""
+        self._append({"kind": "data_state", "step": int(step),
+                      "state": state, "time": time.time()})
+
+    def data_state_at(self, step: int) -> Optional[Dict[str, object]]:
+        """Newest data_state entry with entry.step <= step (a rollback
+        target never needs FUTURE iterator state), or None."""
+        best: Optional[Dict[str, object]] = None
+        best_step = -1
+        for e in self.entries():
+            if e.get("kind") != "data_state":
+                continue
+            s = e.get("step")
+            if isinstance(s, int) and best_step < s <= int(step):
+                best, best_step = e.get("state"), s
+        return best
 
     def world_changes(self) -> List[Dict[str, object]]:
         """All `world_changed` entries in append order — the world-size

@@ -13,6 +13,20 @@ Known sites (the framework's barriers; plans may name new ones freely):
     ckpt.restore  Checkpointer.restore, per step attempted
     data.fetch    default_url_fetcher / OnlineStreamingDataLoader._load_one
     data.stall    loader worker: injects a sleep (wedged-loader chaos)
+    data.decode   record decode barriers (PackedRecordSource /
+                  ShardedPackedRecordSource / OnlineStreamingDataLoader
+                  ._load_one), polled per record with key="<shard>:<idx>"
+                  (or the URL) — a per_key spec corrupts ONE record
+                  deterministically; with a quarantine journal armed it
+                  becomes a placeholder + provenance entry, never an
+                  exception
+    data.poison   dataplane.BatchScreen (run by prefetch_to_device
+                  BEFORE the H2D put): a firing marks the batch
+                  poisoned -> quarantined + skipped, blast radius one
+                  batch
+    data.skew     DataPlane.commit: flips the commit-boundary batch
+                  digest so the cross-host hash vote detects divergence
+                  (typed `data_skew` event)
     step.nan      DiffusionTrainer.fit: poisons the next loss readback
     numerics.nan  DiffusionTrainer.fit: corrupts ONE top-level module's
                   params with NaNs (first module in sorted key order) —

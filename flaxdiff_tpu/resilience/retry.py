@@ -81,6 +81,13 @@ class RetryPolicy:
     seed: Optional[int] = None
     sleep: Callable[[float], None] = time.sleep
     clock: Callable[[], float] = time.monotonic
+    # server-directed backoff floor: given the exception, return a
+    # minimum delay in seconds (or None). Lets HTTP 429/503 honor a
+    # `Retry-After` header instead of retrying into a closed door; the
+    # floor is capped at max_delay so a hostile header cannot stall a
+    # worker unboundedly.
+    delay_floor_from: Optional[
+        Callable[[BaseException], Optional[float]]] = None
 
     def __post_init__(self):
         if self.max_attempts < 1:
@@ -122,6 +129,10 @@ class RetryPolicy:
                             self.max_delay)
                 if self.jitter:
                     delay *= 1.0 - self.jitter * rng.random()
+                if self.delay_floor_from is not None:
+                    floor = self.delay_floor_from(e)
+                    if floor is not None:
+                        delay = max(delay, min(floor, self.max_delay))
                 if (self.deadline is not None
                         and self.clock() - start + delay > self.deadline):
                     events.record("retry_exhausted", site,

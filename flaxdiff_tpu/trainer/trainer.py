@@ -339,6 +339,9 @@ class DiffusionTrainer:
 
         self.best_loss = float("inf")
         self.best_state: Optional[TrainState] = None
+        # the step the best state was snapshotted at — the data plane's
+        # rewind target when a rollback restores it
+        self.best_step: Optional[int] = None
 
         if self._param_template is not None and checkpointer is not None:
             # flat-state checkpoints are unreadable without the template
@@ -427,6 +430,7 @@ class DiffusionTrainer:
             # follows places fresh shards directly on the new mesh.)
             self.state = jax.device_put(self.state, self.state_shardings)
         self.best_state = None      # old-mesh arrays; re-seeded on restore
+        self.best_step = None
         self._compile_programs()
         _res_events.global_event_log().record(
             "mesh_rebuilt", "elastic.world",
@@ -450,6 +454,7 @@ class DiffusionTrainer:
         self.best_loss = best if best > 0 else float("inf")
         if self.config.keep_best_state:
             self.best_state = jax.tree_util.tree_map(jnp.copy, self.state)
+            self.best_step = int(step)
         return int(step)
 
     # -- flash autotuning ----------------------------------------------------
@@ -593,9 +598,11 @@ class DiffusionTrainer:
         self.best_loss = best if best > 0 else float("inf")
         # Seed best_state from the restored state so NaN rollback stays
         # armed after resume (the restored best_loss may never be beaten).
+        restored = int(jax.device_get(self.state.step))
         if self.config.keep_best_state:
             self.best_state = jax.tree_util.tree_map(jnp.copy, self.state)
-        return int(jax.device_get(self.state.step))
+            self.best_step = restored
+        return restored
 
     # -- data movement -------------------------------------------------------
     def put_batch(self, batch: PyTree) -> PyTree:
@@ -726,7 +733,8 @@ class DiffusionTrainer:
             callbacks: Sequence[Callable[[int, float, Dict], None]] = (),
             save_every: Optional[int] = None,
             data_factory: Optional[Callable[[Any], Iterator[PyTree]]]
-            = None) -> Dict[str, Any]:
+            = None,
+            data_plane: Optional[Any] = None) -> Dict[str, Any]:
         """Run `total_steps` steps from `data` (host-local numpy batches).
 
         Returns summary metrics. The hot loop is sync-free pipelined:
@@ -751,6 +759,20 @@ class DiffusionTrainer:
         already-prefetched batch from the old shard may still be
         consumed — an accepted off-by-one on streaming data, recorded
         nowhere because it changes nothing the ledger cares about.
+
+        `data_plane` (a `data.dataplane.DataPlane`) supersedes `data`
+        with a DETERMINISTIC batch stream: the plane's cursor is the
+        replay coordinate. Every rollback (anomaly, quorum, elastic
+        restore) closes the upload worker, rewinds the stream to the
+        landed step's batch boundary, and rebuilds the pipeline, so
+        replayed steps see bit-identical batches; the plane's screen
+        gates each batch before H2D upload (poisoned batches are
+        quarantined with blast radius one batch); each checkpoint
+        commit persists the plane's state through the StepLedger and
+        runs the cross-host batch-hash skew vote. With `data_factory`
+        too, elastic transitions swap the resharded factory INTO the
+        plane (`adopt`) so journal/breaker/digest state survives the
+        world change.
         """
         cfg = self.config
         losses, log_t0 = [], time.perf_counter()
@@ -837,6 +859,12 @@ class DiffusionTrainer:
                 with tel.span("train.restore_at_start", cat="restore"), \
                         goodput.measure_badput("restart"):
                     step0 = self.restore_checkpoint()
+                if data_plane is not None:
+                    # rewind the stream to the restored step's batch
+                    # boundary (journal/breakers reload from the ledger's
+                    # data_state entry, so replay skips the same records)
+                    data_plane.restore(step0,
+                                       ledger=self.checkpointer.ledger)
                 events.record("restored", "train.start",
                               detail=f"resumed from step {step0}",
                               step=step0)
@@ -851,6 +879,39 @@ class DiffusionTrainer:
             if res in history["saves"]:
                 history["saves"][res] += 1
 
+        from ..data.prefetch import prefetch_to_device
+
+        def _new_upload(src):
+            """Build the H2D upload worker; with a data plane its screen
+            gates every batch BEFORE the put and its journal records the
+            quarantined ones."""
+            return prefetch_to_device(
+                self.put_batch, src, depth=max(cfg.pipeline_depth, 1),
+                screen=(data_plane.screen if data_plane is not None
+                        else None),
+                quarantine=(data_plane.journal if data_plane is not None
+                            else None))
+
+        def _rewind_data(step) -> None:
+            """Rewind the deterministic data plane to `step`'s batch
+            boundary and rebuild the upload pipeline: prefetched-but-
+            unconsumed batches are DISCARDED (never replayed out of
+            order), and the next batch consumed is exactly batch index
+            `step` — the bit-identical replay contract. No-op without a
+            data plane or with an unknown landing step (best-state /
+            fresh-rng recoveries that never rewound the step counter
+            to a determinate boundary keep the stream position)."""
+            nonlocal upload, global_batch
+            if data_plane is None or step is None:
+                return
+            upload.close()
+            data_plane.seek(int(step))
+            upload = _new_upload(data_plane)
+            with goodput.measure_badput("data_stall"), \
+                    tel.span("data.rewind_refetch", cat="data",
+                             args={"step": int(step)}):
+                global_batch = next(upload)
+
         def _adopt_change(change, bucket: str, restore_step, t0: float,
                           in_ckpt_phase: bool) -> None:
             """Common adoption of a committed WorldChange: re-arm the
@@ -859,7 +920,7 @@ class DiffusionTrainer:
             the transition demands one, swap the data shard, and put
             the transition on the books (goodput bucket + reclaimed
             estimate, elastic/* metrics, JSONL row, history)."""
-            nonlocal upload
+            nonlocal upload, global_batch
             coord = (self.checkpointer.coordinator
                      if self.checkpointer is not None else None)
             if coord is not None:
@@ -876,9 +937,27 @@ class DiffusionTrainer:
                 inflight.clear()
             if data_factory is not None and elastic is not None:
                 upload.close()
-                upload = prefetch_to_device(
-                    self.put_batch, data_factory(elastic.world_view()),
-                    depth=max(cfg.pipeline_depth, 1))
+                if data_plane is not None:
+                    # swap the resharded factory INTO the plane: the
+                    # journal/breaker/digest state survives the world
+                    # change, and the surviving view resumes at the
+                    # consensus batch boundary — a shrink never
+                    # re-serves samples the survivors already consumed
+                    data_plane.adopt(
+                        data_factory(elastic.world_view()),
+                        cursor=(restore_step if restore_step is not None
+                                else change.step))
+                    upload = _new_upload(data_plane)
+                    with goodput.measure_badput("data_stall"):
+                        global_batch = next(upload)
+                else:
+                    upload = prefetch_to_device(
+                        self.put_batch, data_factory(elastic.world_view()),
+                        depth=max(cfg.pipeline_depth, 1))
+            elif restore_step is not None:
+                # no factory swap, but the restore rewound the step
+                # counter: replay must see the same batches again
+                _rewind_data(restore_step)
             dt = time.perf_counter() - t0
             goodput.record_badput(bucket, dt)
             reclaimed = elastic.reclaimed_estimate(change.step, dt,
@@ -987,9 +1066,11 @@ class DiffusionTrainer:
                     with tel.span("elastic.quorum_rollback", cat="restore",
                                   args={"step": decision.step}):
                         self._elastic_restore(decision.step)
+                    _rewind_data(decision.step)
                 else:
                     # pod-sick with nothing committed: best-state path
-                    self._recover(float("nan"), step=step_no)
+                    landed = self._recover(float("nan"), step=step_no)
+                    _rewind_data(landed)
                 ring_pending[0] = 0
                 loss_window.clear()
                 inflight.clear()
@@ -1042,6 +1123,12 @@ class DiffusionTrainer:
                 if not final:
                     stop["flag"] = True
                 return
+            if data_plane is not None and committed is not None:
+                # data-plane state commits BESIDE the model commit (same
+                # ledger), and the cross-host batch-hash vote runs here —
+                # KV/ledger traffic only, zero device syncs
+                data_plane.commit(committed,
+                                  ledger=self.checkpointer.ledger)
             if elastic is not None and not final and not stop["flag"]:
                 _elastic_boundary(committed)
 
@@ -1080,12 +1167,13 @@ class DiffusionTrainer:
                 self._nan_provenance(step_batch, tel, step_no)
             if hard and cfg.anomaly_action == "rollback" \
                     and elastic is None:
-                self._recover(flat.get("numerics/loss", float("nan")),
-                              step=step_no)
+                landed = self._recover(
+                    flat.get("numerics/loss", float("nan")), step=step_no)
                 # the restore rewound the step counter: unfetched ring
                 # slots no longer map to live steps — drop them (the
                 # rollback event records what happened to the window)
                 ring_pending[0] = 0
+                _rewind_data(landed)
             return bool(hard)
 
         # SIGTERM -> finish the current step, checkpoint, return. Only
@@ -1232,9 +1320,9 @@ class DiffusionTrainer:
                                    "productive")
             compile_busies.clear()
 
-        from ..data.prefetch import prefetch_to_device
-        upload = prefetch_to_device(self.put_batch, data,
-                                    depth=max(cfg.pipeline_depth, 1))
+        # with a data plane, the plane IS the batch stream (its cursor
+        # is the replay coordinate every rollback rewinds to)
+        upload = _new_upload(data_plane if data_plane is not None else data)
         try:
             with goodput.measure_badput("data_stall"), \
                     tel.span("data.first_batch", cat="data"):
@@ -1464,7 +1552,8 @@ class DiffusionTrainer:
                     if recovered:
                         pass    # transition emptied the window above
                     elif anomaly is not None:
-                        self._recover(loss, step=i + 1)
+                        landed = self._recover(loss, step=i + 1)
+                        _rewind_data(landed)
                         steps_in_window = 0
                         log_t0 = time.perf_counter()
                         recovered = True
@@ -1532,6 +1621,7 @@ class DiffusionTrainer:
                             self.best_loss = loss
                             self.best_state = jax.tree_util.tree_map(
                                 jnp.copy, self.state)
+                            self.best_step = i + 1
                         if timed:
                             tel.gauge("train/loss").set(loss)
                             tel.gauge("train/imgs_per_sec").set(ips)
@@ -1577,8 +1667,9 @@ class DiffusionTrainer:
                                 loss_now, nan_pending = float("nan"), False
                             if detector.abnormal_loss(
                                     loss_now, step=i + 1) is not None:
-                                self._recover(loss_now, step=i + 1)
+                                landed = self._recover(loss_now, step=i + 1)
                                 ring_pending[0] = 0   # slots rewound
+                                _rewind_data(landed)
                                 do_save = False
                         if do_save:
                             with tel.span("ckpt.save_and_commit",
@@ -1648,14 +1739,20 @@ class DiffusionTrainer:
                          if v - gp_base_bad.get(k, 0.0) > 0.0}}
         return history
 
-    def _recover(self, bad_loss: float, step: Optional[int] = None):
+    def _recover(self, bad_loss: float,
+                 step: Optional[int] = None) -> Optional[int]:
         """Abnormal-loss / anomaly recovery (reference
         simple_trainer.py:542-575): restore the best state if we have
         one; with no best state yet but a checkpointer holding a
         restorable step, walk back to it (the PR-1/2 fallback-restore
         path — corrupt newer steps are skipped, ledger mode restores
         only committed steps). Only with neither does the run continue
-        on a fresh rng fold."""
+        on a fresh rng fold.
+
+        Returns the step the run landed on (the best state's snapshot
+        step / the restored checkpoint step), or None when it continued
+        in place — the data plane rewinds its stream to this boundary
+        so replayed steps see bit-identical batches."""
         tel = self.telemetry if self.telemetry is not None \
             else _global_telemetry()
         if self.best_state is not None:
@@ -1667,7 +1764,7 @@ class DiffusionTrainer:
                           args={"step": step, "loss": repr(bad_loss)}):
                 self.state = jax.tree_util.tree_map(jnp.copy,
                                                     self.best_state)
-            return
+            return self.best_step
         if self.checkpointer is not None \
                 and self.checkpointer.latest_step() is not None:
             with tel.span("train.rollback", cat="restore",
@@ -1679,7 +1776,7 @@ class DiffusionTrainer:
                 detail=f"abnormal loss {bad_loss}; no best state — "
                        f"restored checkpoint step {restored}",
                 step=step)
-            return
+            return restored
         _res_events.global_event_log().record(
             "rollback", "train.step",
             detail=f"abnormal loss {bad_loss}; no best state — "
@@ -1687,6 +1784,7 @@ class DiffusionTrainer:
             step=step)
         # keep going with fresh RNG fold — the step folds rng by step
         # counter, so the next batch draws different noise.
+        return None
 
     # -- inference-side helpers ---------------------------------------------
     def get_params(self, use_ema: bool = True) -> PyTree:

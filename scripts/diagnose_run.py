@@ -359,6 +359,79 @@ def frontdoor_section(metrics: List[Dict], health: List[Dict],
     lines.append("")
 
 
+def data_health_section(metrics: List[Dict], quarantines: List[Dict],
+                        breakers: List[Dict], skews: List[Dict],
+                        lines: List[str]) -> None:
+    """Data-plane health (docs/DATA.md): quarantine provenance, the
+    per-source breaker state timeline, fetch latency/hedging, rewinds,
+    and the commit-boundary skew votes."""
+    last = metrics[-1] if metrics else {}
+    have_counters = any(
+        k.startswith(("data/quarantined", "data/batches_out",
+                      "data/poisoned_batches", "data/breaker_",
+                      "data/fetch_", "data/stream_rewinds",
+                      "data/skew_", "data/starvation_escalations"))
+        for k in last)
+    if not have_counters and not quarantines and not breakers \
+            and not skews:
+        return
+    lines.append("== Data health ==")
+
+    def g(name: str, default=0.0):
+        v = last.get(name, default)
+        return float(v) if isinstance(v, (int, float)) else default
+
+    lines.append(f"batches out:        {g('data/batches_out'):.0f} "
+                 f"({g('data/stream_rewinds'):.0f} stream rewinds, "
+                 f"{g('data/poisoned_batches'):.0f} poisoned pre-upload)")
+    lines.append(f"quarantined:        {g('data/quarantined'):.0f} "
+                 f"records ({len(quarantines)} journal rows in stream)")
+    for q in quarantines[-5:]:
+        lines.append(f"  [{q.get('seq', '?')}] "
+                     f"{q.get('source', '?')}:{q.get('key', '?')} -> "
+                     f"{q.get('reason', '?')}")
+    trips = g("data/breaker_trips")
+    if trips or breakers:
+        lines.append(f"breakers:           {trips:.0f} trips, "
+                     f"{g('data/breaker_probes'):.0f} probes, "
+                     f"{g('data/breaker_skips'):.0f} skipped fetches")
+        # one timeline per source: every recorded state TRANSITION
+        per: Dict[str, List[Dict]] = {}
+        for r in breakers:
+            per.setdefault(str(r.get("source", "?")), []).append(r)
+        for name in sorted(per):
+            hops = " -> ".join(str(r.get("state", "?"))
+                               for r in per[name])
+            tail = per[name][-1]
+            lines.append(f"  source {name:<12s} {hops} "
+                         f"(ewma {float(tail.get('ewma', 0.0)):.2f}, "
+                         f"trips {int(tail.get('trips', 0))})")
+    cnt = g("data/fetch_ms/count")
+    if cnt:
+        lines.append(
+            f"fetch_ms:           p50 {g('data/fetch_ms/p50'):>9.2f}   "
+            f"p99 {g('data/fetch_ms/p99'):>9.2f}   max "
+            f"{g('data/fetch_ms/max'):>9.2f}   n {cnt:.0f}  "
+            f"(hedges {g('data/fetch_hedges'):.0f}, "
+            f"hedge wins {g('data/fetch_hedge_wins'):.0f})")
+    esc = g("data/starvation_escalations")
+    if esc:
+        lines.append(f"starvation:         {esc:.0f} escalations past "
+                     f"fallback")
+    votes = g("data/skew_votes")
+    if votes or skews:
+        detected = g("data/skew_detected")
+        lines.append(f"skew votes:         {votes:.0f} "
+                     f"({detected:.0f} DISAGREED)"
+                     + ("  <- input streams diverged" if detected
+                        else ""))
+        for s in [r for r in skews if not r.get("agreed", True)][-5:]:
+            lines.append(f"  step {s.get('step', '?')}: digest "
+                         f"{s.get('digest', '?')} across world of "
+                         f"{s.get('world', '?')} — MISMATCH")
+    lines.append("")
+
+
 def reqtrace_section(traces: List[Dict], lines: List[str]) -> None:
     """Request-level latency attribution (telemetry/reqtrace.py): the
     per-span breakdown across every traced request, plus a drill-down
@@ -510,6 +583,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     fd_health = [r for r in records
                  if r.get("type") == "frontdoor_health"]
     tenant_slo = [r for r in records if r.get("type") == "tenant_slo"]
+    quarantines = [r for r in records
+                   if r.get("type") == "data_quarantine"]
+    breakers = [r for r in records if r.get("type") == "data_breaker"]
+    skews = [r for r in records if r.get("type") == "data_skew"]
 
     programs: List[Dict] = []
     prog_path = os.path.join(directory, "programs.jsonl")
@@ -562,7 +639,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                    "tenant_slo": tenant_slo,
                    "counters": {k: v for k, v in
                                 (metrics[-1] if metrics else {}).items()
-                                if k.startswith("frontdoor/")}}}
+                                if k.startswith("frontdoor/")}},
+               "data_health": {
+                   "quarantine": quarantines,
+                   "breaker_timeline": breakers,
+                   "skew_votes": skews,
+                   "counters": {k: v for k, v in
+                                (metrics[-1] if metrics else {}).items()
+                                if k.startswith("data/")}}}
         ok_traces = [t for t in reqtraces
                      if t.get("outcome", "ok") == "ok"]
         span_stats = {}
@@ -594,6 +678,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     pod_section(pods, lines)
     serving_section(metrics, lines)
     frontdoor_section(metrics, fd_health, tenant_slo, lines)
+    data_health_section(metrics, quarantines, breakers, skews, lines)
     reqtrace_section(reqtraces, lines)
     programs_section(programs, lines)
     counters_section(metrics, lines)

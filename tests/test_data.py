@@ -226,6 +226,133 @@ def test_online_loader_lazy_process_shard():
     assert [v[i] for i in range(len(v))] == [10, 50, 90]
 
 
+def test_fetcher_429_retry_after_floor_honored():
+    """ISSUE 17 satellite: HTTP 429/503 are retryable-with-backoff AND
+    honor the server's Retry-After header (delta-seconds) as a floor on
+    the backoff delay — retrying sooner just burns budget against a
+    closed door."""
+    import email.message
+    import urllib.error
+
+    from flaxdiff_tpu.data.online_loader import (default_url_fetcher,
+                                                 retry_after_floor)
+    from flaxdiff_tpu.resilience.retry import RetryPolicy
+
+    headers = email.message.Message()
+    headers["Retry-After"] = "2"
+    attempts = []
+
+    class _Resp:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+        def read(self):
+            return b"payload"
+
+    def opener(url, timeout=None):
+        attempts.append(url)
+        if len(attempts) <= 2:
+            raise urllib.error.HTTPError(url, 429, "throttled",
+                                         headers, None)
+        return _Resp()
+
+    sleeps = []
+    pol = RetryPolicy(max_attempts=3, base_delay=0.01, max_delay=30.0,
+                      jitter=0.0, sleep=sleeps.append,
+                      delay_floor_from=retry_after_floor)
+    fetch = default_url_fetcher(policy=pol, opener=opener)
+    assert fetch("http://x/throttled") == b"payload"
+    assert len(attempts) == 3
+    # both backoffs were floored to the server-directed 2s (the policy's
+    # own schedule would have been 0.01s / 0.02s)
+    assert sleeps == [2.0, 2.0]
+
+
+def test_fetcher_503_retryable_and_404_is_not():
+    import urllib.error
+
+    from flaxdiff_tpu.data.online_loader import default_url_fetcher
+    from flaxdiff_tpu.resilience.retry import RetryPolicy
+
+    calls = {"n": 0}
+
+    class _Resp:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+        def read(self):
+            return b"ok"
+
+    def opener(url, timeout=None):
+        calls["n"] += 1
+        if "unavailable" in url and calls["n"] == 1:
+            raise urllib.error.HTTPError(url, 503, "down", None, None)
+        if "gone" in url:
+            raise urllib.error.HTTPError(url, 404, "gone", None, None)
+        return _Resp()
+
+    pol = RetryPolicy(max_attempts=3, base_delay=0.0, jitter=0.0,
+                      sleep=lambda s: None)
+    fetch = default_url_fetcher(policy=pol, opener=opener)
+    assert fetch("http://x/unavailable") == b"ok"   # 503 retried
+    calls["n"] = 0
+    with pytest.raises(urllib.error.HTTPError):     # 404 propagates
+        fetch("http://x/gone")
+    assert calls["n"] == 1                          # after ONE attempt
+
+
+def test_retry_after_floor_parsing():
+    from flaxdiff_tpu.data.online_loader import retry_after_floor
+
+    class _E(Exception):
+        def __init__(self, code, headers):
+            self.code, self.headers = code, headers
+
+    assert retry_after_floor(_E(429, {"Retry-After": "7"})) == 7.0
+    assert retry_after_floor(_E(503, {"Retry-After": " 1.5 "})) == 1.5
+    # HTTP-date form falls back to the policy schedule
+    assert retry_after_floor(
+        _E(429, {"Retry-After": "Wed, 21 Oct 2026 07:28:00 GMT"})) is None
+    assert retry_after_floor(_E(429, {})) is None       # no header
+    assert retry_after_floor(_E(500, {"Retry-After": "9"})) is None
+    assert retry_after_floor(ValueError("x")) is None   # no code at all
+
+
+def test_grain_reshard_composes_with_resumable_state(toy_images):
+    """ISSUE 17 satellite: an elastic shrink mid-epoch adopts the
+    resharded loader AT the consensus cursor — the post-shrink stream
+    continues exactly where the resharded view's own uninterrupted
+    stream would be (bit-identical), re-serving nothing already
+    consumed."""
+    from flaxdiff_tpu.data import DataPlane
+    from flaxdiff_tpu.data.dataplane import batch_digest
+
+    ds = get_dataset("synthetic", n=32, image_size=8)
+    loaded = get_dataset_grain(ds, batch_size=8, image_size=8, seed=0)
+
+    # survivor's reference: rank 0 of 2, uninterrupted from batch 0
+    ref_it = loaded["reshard"](0, 2)(seed=0)
+    reference = [batch_digest(next(ref_it)) for _ in range(10)]
+
+    plane = DataPlane(loaded["train"], seed=0)
+    consumed = [batch_digest(next(plane)) for _ in range(5)]
+    # shrink at committed step 5: adopt the resharded factory at the
+    # consensus cursor
+    plane.adopt(loaded["reshard"](0, 2), cursor=5)
+    post = [batch_digest(next(plane)) for _ in range(5)]
+    assert post == reference[5:10]
+    # GrainIterator.seek landed on the exact boundary: cursor advanced
+    # monotonically, so no pre-shrink batch was re-served
+    assert plane.stream.cursor == 10
+    assert not set(post) & set(consumed)
+
+
 def test_tfds_source_registered_and_gated():
     """The TFDS adapter (reference's canonical flowers path) registers
     and either loads (tfds installed) or fails with the actionable

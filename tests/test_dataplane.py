@@ -1,0 +1,289 @@
+"""Unit tests for the deterministic data plane (ISSUE 17):
+quarantine journal, circuit breakers, hedged fetch, starvation ladder,
+batch screen, resumable stream/plane, and the commit-boundary skew vote.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from flaxdiff_tpu import resilience as R
+from flaxdiff_tpu.data.dataplane import (
+    BatchScreen,
+    BreakerBoard,
+    DataPlane,
+    HedgedFetcher,
+    QuarantineJournal,
+    ResumableStream,
+    SourceBreaker,
+    StarvationLadder,
+    batch_digest,
+    placeholder_record,
+)
+from flaxdiff_tpu.resilience.coordination import InMemoryTransport, StepLedger
+
+
+# -- batch_digest -------------------------------------------------------------
+
+def test_batch_digest_order_stable_and_content_sensitive():
+    a = {"sample": np.arange(12, dtype=np.float32).reshape(3, 4),
+         "text": ["a", "b", "c"]}
+    b = {"text": ["a", "b", "c"],
+         "sample": np.arange(12, dtype=np.float32).reshape(3, 4)}
+    assert batch_digest(a) == batch_digest(b)     # key order irrelevant
+    c = {**a, "sample": a["sample"] + 1}
+    assert batch_digest(a) != batch_digest(c)     # values matter
+    # a reshaped identical buffer digests differently (shape prefixed)
+    d = {**a, "sample": a["sample"].reshape(4, 3)}
+    assert batch_digest(a) != batch_digest(d)
+
+
+# -- QuarantineJournal --------------------------------------------------------
+
+def test_journal_dedupes_replay_reencounters():
+    j = QuarantineJournal()
+    assert j.note("shard0", "rec:5", "decode failed") is True
+    assert j.note("shard0", "rec:5", "decode failed") is False   # replay
+    assert j.note("shard0", "rec:6", "decode failed") is True
+    assert len(j) == 2
+    assert [e["key"] for e in j.entries()] == ["rec:5", "rec:6"]
+
+
+def test_journal_state_roundtrip():
+    j = QuarantineJournal()
+    j.note("s", "k1", "r1")
+    j.note("s", "k2", "r2")
+    j2 = QuarantineJournal()
+    j2.load_state_dict(j.state_dict())
+    assert j2.entries() == j.entries()
+    # restored journal keeps deduping against restored entries
+    assert j2.note("s", "k1", "r1") is False
+
+
+def test_placeholder_record_geometry():
+    rec = placeholder_record(image_size=16)
+    assert rec["image"].shape == (16, 16, 3)
+    assert rec["image"].dtype == np.uint8
+    assert not rec["image"].any()
+    assert rec["text"] == ""
+
+
+# -- SourceBreaker / BreakerBoard ---------------------------------------------
+
+def test_breaker_trips_cools_down_and_recloses():
+    br = SourceBreaker("laion", threshold=0.5, alpha=0.5,
+                       min_samples=3, cooldown=4, probes=2)
+    for _ in range(3):
+        assert br.allow()
+        br.record_error()
+    assert br.state == "open" and br.trips == 1
+    # cooldown counted in POLLS, deterministically
+    assert [br.allow() for _ in range(3)] == [False, False, False]
+    assert br.allow() is True          # 4th poll -> half-open probe 1
+    br.record_ok()
+    assert br.allow() is True          # probe 2
+    br.record_ok()                     # all probes clean -> closed
+    assert br.state == "closed" and br.ewma == 0.0
+
+
+def test_breaker_failed_probe_reopens():
+    br = SourceBreaker("s", threshold=0.5, alpha=1.0,
+                       min_samples=1, cooldown=2, probes=1)
+    br.record_error()
+    assert br.state == "open"
+    assert not br.allow()
+    assert br.allow()                  # half-open probe
+    br.record_error()                  # probe failed
+    assert br.state == "open" and br.trips == 2
+
+
+def test_breaker_state_roundtrip_is_exact():
+    br = SourceBreaker("s", min_samples=1, alpha=1.0, cooldown=8)
+    br.record_error()
+    br.allow()
+    br2 = SourceBreaker("s", min_samples=1, alpha=1.0, cooldown=8)
+    br2.load_state_dict(br.state_dict())
+    # both breakers now produce the identical decision sequence
+    assert [br.allow() for _ in range(10)] == \
+        [br2.allow() for _ in range(10)]
+
+
+def test_breaker_board_weights_renormalize():
+    board = BreakerBoard(threshold=0.5, alpha=1.0, min_samples=1)
+    board.record("a", ok=True)
+    board.record("b", ok=True)
+    board.record("c", ok=False)        # trips c
+    assert board.open_sources() == ["c"]
+    w = board.weights()
+    assert w["c"] == 0.0
+    assert w["a"] == w["b"] == pytest.approx(0.5)
+
+
+# -- HedgedFetcher ------------------------------------------------------------
+
+def test_hedged_fetch_values_unchanged_and_hedge_fires():
+    calls = []
+    gate = threading.Event()
+
+    def fetcher(url):
+        calls.append(url)
+        if len(calls) > 3 and len(calls) % 2 == 0:
+            # even-numbered late calls are slow primaries; the hedge
+            # (the next call) returns immediately with the same value
+            gate.wait(1.0)
+        return f"bytes:{url}".encode()
+
+    hf = HedgedFetcher(fetcher, percentile=0.5, min_observations=3,
+                       max_wait=5.0)
+    for i in range(3):
+        assert hf(f"u{i}") == f"bytes:u{i}".encode()
+    out = hf("slow")                   # outlives the p50 cutoff -> hedge
+    gate.set()
+    assert out == b"bytes:slow"        # value identical either way
+    assert calls.count("slow") == 2    # hedge arm actually launched
+
+
+def test_hedged_fetch_propagates_errors():
+    def fetcher(url):
+        raise IOError("dead url")
+
+    hf = HedgedFetcher(fetcher, min_observations=1000)
+    with pytest.raises(IOError, match="dead url"):
+        hf("u")
+
+
+# -- StarvationLadder ---------------------------------------------------------
+
+def test_starvation_ladder_rungs_and_reset():
+    lad = StarvationLadder(degrade_after=2, raise_after=4)
+    assert lad.observe_starved() == "fallback"
+    assert lad.observe_starved() == "degrade"
+    assert lad.observe_starved() == "degrade"
+    assert lad.observe_starved() == "raise"
+    lad.observe_ok()
+    assert lad.observe_starved() == "fallback"   # one good batch resets
+
+
+# -- BatchScreen --------------------------------------------------------------
+
+def test_screen_flags_nonfinite_and_geometry_drift():
+    s = BatchScreen()
+    good = {"sample": np.zeros((4, 8, 8, 1), np.float32)}
+    assert s(good) is None
+    bad = {"sample": np.full((4, 8, 8, 1), np.nan, np.float32)}
+    assert "non-finite" in s(bad)
+    drift = {"sample": np.zeros((4, 4, 4, 1), np.float32)}
+    assert "geometry drift" in s(drift)
+    # state roundtrip carries the locked reference geometry
+    s2 = BatchScreen()
+    s2.load_state_dict(s.state_dict())
+    assert s2(good) is None
+    assert "geometry drift" in s2(drift)
+
+
+def test_screen_data_poison_fault_site():
+    plan = R.FaultPlan([R.FaultSpec("data.poison", prob=1.0,
+                                    error="flag", times=1)])
+    s = BatchScreen()
+    with plan.installed():
+        assert s({"sample": np.zeros((2, 2), np.float32)}) \
+            == "injected: data.poison"
+    assert s({"sample": np.zeros((2, 2), np.float32)}) is None
+
+
+# -- ResumableStream / DataPlane ----------------------------------------------
+
+def _counting_factory(n_per_epoch=8):
+    def factory(seed):
+        def gen():
+            epoch = 0
+            while True:
+                rng = np.random.default_rng(seed + epoch)
+                for _ in range(n_per_epoch):
+                    yield {"sample": rng.normal(
+                        size=(2, 4, 4, 1)).astype(np.float32)}
+                epoch += 1
+        return gen()
+    return factory
+
+
+def test_resumable_stream_seek_bit_identical():
+    f = _counting_factory()
+    ref = [batch_digest(b) for _, b in zip(range(20), f(0))]
+    s = ResumableStream(f, seed=0)
+    for _ in range(13):
+        next(s)
+    s.seek(5)
+    assert s.cursor == 5
+    replay = [batch_digest(next(s)) for _ in range(10)]
+    assert replay == ref[5:15]
+
+
+def test_dataplane_seek_and_digest_ring():
+    plane = DataPlane(_counting_factory(), seed=0)
+    ref = [batch_digest(next(plane)) for _ in range(10)]
+    plane.seek(4)
+    assert plane.rewinds == 1
+    # digests past the rewind point were dropped; replay recomputes them
+    assert max(plane._digests) == 3
+    assert [batch_digest(next(plane)) for _ in range(6)] == ref[4:]
+
+
+def test_dataplane_commit_restore_through_real_ledger(tmp_path):
+    ledger = StepLedger(str(tmp_path))
+    plane = DataPlane(_counting_factory(), seed=0)
+    plane.journal.note("src", "rec:3", "decode failed")
+    ref = [batch_digest(next(plane)) for _ in range(9)]
+    assert plane.commit(6, ledger=ledger) is True   # solo world agrees
+    state = ledger.data_state_at(6)
+    assert state is not None and state["cursor"] == 6
+    # a FRESH plane (restart) restores journal + cursor from the ledger
+    plane2 = DataPlane(_counting_factory(), seed=0)
+    plane2.restore(6, ledger=ledger)
+    assert [e["key"] for e in plane2.journal.entries()] == ["rec:3"]
+    assert [batch_digest(next(plane2)) for _ in range(3)] == ref[6:9]
+
+
+def test_dataplane_adopt_does_not_reserve_consumed_samples():
+    plane = DataPlane(_counting_factory(), seed=0)
+    ref = [batch_digest(next(plane)) for _ in range(12)]
+    # elastic world change at committed step 7: the (re)adopted factory
+    # starts at batch 7, not at 0 — nothing already consumed re-serves
+    plane.adopt(_counting_factory(), cursor=7)
+    assert batch_digest(next(plane)) == ref[7]
+
+
+def test_dataplane_skew_vote_detects_divergence():
+    t0, t1 = InMemoryTransport.make_world(2)
+    f = _counting_factory()
+    p0 = DataPlane(f, seed=0, transport=t0)
+    p1 = DataPlane(f, seed=0, transport=t1)
+    for _ in range(4):
+        next(p0)
+        next(p1)
+    results = {}
+
+    def vote(name, plane, plan=None):
+        if plan is None:
+            results[name] = plane.commit(4)
+        else:
+            with plan.installed():
+                results[name] = plane.commit(4)
+
+    # round 1: identical streams -> agreement on both hosts
+    th = threading.Thread(target=vote, args=("a0", p0))
+    th.start()
+    vote("a1", p1)
+    th.join(10)
+    assert results["a0"] is True and results["a1"] is True
+    # round 2: host 1's digest flipped by the data.skew fault site
+    for _ in range(2):
+        next(p0)
+        next(p1)
+    plan = R.FaultPlan([R.FaultSpec("data.skew", prob=1.0,
+                                    error="flag", times=1)])
+    th = threading.Thread(target=vote, args=("b0", p0))
+    th.start()
+    vote("b1", p1, plan=plan)
+    th.join(10)
+    assert results["b0"] is False and results["b1"] is False

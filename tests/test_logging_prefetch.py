@@ -6,7 +6,7 @@ import time
 import numpy as np
 import pytest
 
-from flaxdiff_tpu.data.prefetch import prefetch_map
+from flaxdiff_tpu.data.prefetch import prefetch_map, prefetch_to_device
 from flaxdiff_tpu.trainer.logging import (JsonlLogger, MultiLogger,
                                           make_logger, save_image_grid)
 
@@ -159,3 +159,114 @@ class TestPrefetchMap:
         assert next(it) == 0
         it.close()   # generator finalizer sets the stop flag
         self._assert_no_new_workers(before)
+
+
+class TestPrefetchToDevice:
+    """ISSUE 17 satellite: upload-prefetch regression tests — clean
+    teardown with an in-flight raising put_fn, starvation surfacing
+    through a depth-2 pipeline, and no stranded buffers on close."""
+
+    @staticmethod
+    def _live_workers():
+        return {t for t in threading.enumerate()
+                if t.name == "flaxdiff-put-batch" and t.is_alive()}
+
+    def _assert_no_new_workers(self, before, timeout=3.0):
+        deadline = time.time() + timeout
+        while self._live_workers() - before and time.time() < deadline:
+            time.sleep(0.05)
+        leaked = self._live_workers() - before
+        assert not leaked, leaked
+
+    def test_close_with_raising_put_fn_no_leaked_worker(self):
+        """close() while put_fn is mid-failure must not hang or leak the
+        worker thread — the error path and the stop path race by design
+        and both must terminate."""
+        before = self._live_workers()
+
+        def put_fn(x):
+            if x >= 2:
+                raise RuntimeError("device OOM during upload")
+            return x
+
+        pf = prefetch_to_device(put_fn, iter(range(100)), depth=2)
+        assert next(pf) == 0
+        pf.close()                       # worker may be raising right now
+        self._assert_no_new_workers(before)
+        # a closed pipeline never hands out a stale buffer
+        with pytest.raises((StopIteration, RuntimeError)):
+            next(pf)
+
+    def test_put_fn_exception_reraises_at_next(self):
+        before = self._live_workers()
+        pf = prefetch_to_device(
+            lambda x: 1 // x, iter([2, 1, 0, 5]), depth=2)
+        assert next(pf) == 0
+        assert next(pf) == 1
+        with pytest.raises(ZeroDivisionError):
+            next(pf)
+        with pytest.raises(StopIteration):   # pipeline is dead, stays dead
+            next(pf)
+        self._assert_no_new_workers(before)
+
+    def test_starvation_raise_surfaces_through_depth2_pipeline(self):
+        """A starving source (starvation_action='raise' semantics) behind
+        a depth-2 upload pipeline: the RuntimeError crosses the thread
+        boundary to the consumer's next(), after the already-uploaded
+        batches drain, and the worker terminates."""
+        before = self._live_workers()
+
+        def starving_source():
+            yield {"n": 0}
+            yield {"n": 1}
+            raise RuntimeError("no batch within 1.0s (starvation)")
+
+        pf = prefetch_to_device(lambda b: b, starving_source(), depth=2)
+        assert next(pf)["n"] == 0
+        assert next(pf)["n"] == 1
+        with pytest.raises(RuntimeError, match="starvation"):
+            next(pf)
+        self._assert_no_new_workers(before)
+
+    def test_close_discards_window_no_stranded_buffers(self):
+        """In-flight accounting: after close(), submitted - delivered is
+        the discarded window, bounded by depth + 1 — nothing stranded,
+        nothing double-counted."""
+        before = self._live_workers()
+        depth = 2
+        pf = prefetch_to_device(lambda x: x, iter(range(1000)),
+                                depth=depth)
+        for k in range(3):
+            assert next(pf) == k
+        pf.close()
+        self._assert_no_new_workers(before)
+        st = pf.state_dict()
+        assert st["delivered"] == 3
+        assert st["in_flight"] == st["submitted"] - st["delivered"]
+        assert 0 <= st["in_flight"] <= depth + 1
+
+    def test_screen_quarantines_and_counts(self):
+        """The pre-upload screen skips poisoned batches BEFORE put_fn
+        (no H2D copy), notes them in the quarantine journal, and the
+        healthy stream arrives intact and in order."""
+        from flaxdiff_tpu.data import QuarantineJournal
+
+        uploaded = []
+
+        def put_fn(x):
+            uploaded.append(x)
+            return x
+
+        journal = QuarantineJournal()
+        pf = prefetch_to_device(
+            put_fn, iter(range(8)), depth=2,
+            screen=lambda x: "poison" if x % 3 == 2 else None,
+            quarantine=journal)
+        assert list(pf) == [0, 1, 3, 4, 6, 7]
+        assert uploaded == [0, 1, 3, 4, 6, 7]    # screened never uploaded
+        st = pf.state_dict()
+        assert st["screened_out"] == 2
+        assert st["submitted"] == st["delivered"] == 6
+        assert st["in_flight"] == 0
+        assert len(journal) == 2
+        assert all(e["source"] == "prefetch" for e in journal.entries())
