@@ -2033,6 +2033,7 @@ def stage_serve(args) -> dict:
         from flaxdiff_tpu import resilience as R
         from flaxdiff_tpu.serving import (FrontDoor, FrontDoorConfig,
                                           build_pool)
+        from flaxdiff_tpu.telemetry import list_incidents
         tels = [Telemetry(enabled=False) for _ in range(2)]
         pool = build_pool(
             [DiffusionInferencePipeline.from_config(config, params=params)
@@ -2040,7 +2041,13 @@ def stage_serve(args) -> dict:
             scheduler_config=SchedulerConfig(
                 round_steps=4, batch_buckets=(4,), max_inflight=2),
             telemetries=tels, autostart=False)
-        door_tel = Telemetry(enabled=False)
+        # ENABLED door hub (ISSUE 18): Telemetry.create wires the
+        # flight recorder to the global resilience event log, so the
+        # replica kill below dumps a correlated incident-*.json bundle
+        # into this directory — `scripts/diagnose_run.py <dir>` renders
+        # it under "Incidents"
+        door_dir = os.path.join(args.trace, "pool_door")
+        door_tel = Telemetry.create(door_dir)
         door = FrontDoor(pool, telemetry=door_tel,
                          config=FrontDoorConfig(max_attempts=3))
         try:
@@ -2066,23 +2073,31 @@ def stage_serve(args) -> dict:
         finally:
             door.close(drain=False)
         dsnap = door_tel.registry.snapshot()
+        door_tel.close()
         summary["failovers"] = dsnap.get("frontdoor/failovers", 0)
         summary["replica_lost"] = dsnap.get("frontdoor/replica_lost", 0)
         summary["pool_exhausted"] = dsnap.get(
             "frontdoor/pool_exhausted", 0)
         summary["survivor_re_traces"] = tels[1].registry.snapshot().get(
             "serving/program_cache_misses", 0.0) - miss0
+        incidents = list_incidents(door_dir)
+        summary["incidents"] = [os.path.basename(p) for p in incidents]
         res["pool"] = summary
         res["pool_zero_stranded"] = bool(
             summary["completed"] + summary["shed"]
             + summary["faulted"] + summary["errors"] == n)
         res["pool_survivor_retrace_free"] = bool(
             summary["survivor_re_traces"] == 0)
+        res["pool_incident_recorded"] = bool(
+            summary["replica_lost"] == 0
+            or any("replica_lost" in p for p in summary["incidents"]))
+        res["pool_telemetry_dir"] = door_dir
         log(f"serve pool: completed={summary['completed']} "
             f"failovers={summary['failovers']}, "
             f"replica_lost={summary['replica_lost']}, "
             f"survivor_re_traces={summary['survivor_re_traces']}, "
-            f"zero_stranded={res['pool_zero_stranded']}")
+            f"zero_stranded={res['pool_zero_stranded']}, "
+            f"incidents={summary['incidents']}")
     res["warm_retrace_free"] = bool(
         res.get("warm", {}).get("re_traces", 1) == 0)
     res["cached_warm_retrace_free"] = bool(

@@ -24,6 +24,11 @@ ALLOWLIST: Dict[str, Dict[str, int]] = {
         # routing path is a regression, not new debt to budget
         "flaxdiff_tpu/serving/frontdoor.py": 0,
         "flaxdiff_tpu/serving/replica.py": 0,
+        # the SLO engine and flight recorder are host bookkeeping by
+        # contract: explicit ZERO pins (ISSUE 18) — a device sync in
+        # either would silently tax every request they observe
+        "flaxdiff_tpu/telemetry/slo.py": 0,
+        "flaxdiff_tpu/telemetry/flightrec.py": 0,
         "flaxdiff_tpu/serving/loadgen.py": 2,
         "flaxdiff_tpu/trainer/autoencoder_trainer.py": 4,
         "flaxdiff_tpu/trainer/logging.py": 2,

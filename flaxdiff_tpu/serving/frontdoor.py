@@ -33,6 +33,19 @@ The door owns what no single scheduler can:
   replicas die) — degradation escalates for the whole fleet at once
   instead of per-replica.
 
+Observability (docs/OBSERVABILITY.md "Trace propagation"): the door
+mints one trace id per request and PROPAGATES it into the routed
+replica's scheduler (`Replica.submit(trace_ctx=...)`), so door-phase
+spans (`door.route` / `door.attempt` / `door.failover` / `door.hedge`)
+and the replica's `req.queue`/`req.serve` spans share one Chrome lane.
+The non-overlapping door phases tile [submit, delivery] at SHARED
+timestamps, so their sums reconcile with `frontdoor/latency_ms`
+exactly. An online `SloEngine` (telemetry/slo.py) attributes every
+terminal outcome to the request's tenant budget and to a per-replica
+`replica:<name>` series; burn rates drive `BrownoutPolicy.tier_for`
+(over-budget tenants degrade first) and a routing penalty (a replica
+burning its delivery objective ranks behind peers in its health class).
+
 The chaos site `serving.replica_lost` (resilience/faults.py) is polled
 once per replica per submission with key="replica:<name>:"; a firing
 kills that replica mid-traffic — the deterministic lever the pool
@@ -55,6 +68,7 @@ from typing import Any, Deque, Dict, List, Optional, Sequence, Set, Tuple
 from ..resilience import faults as _faults
 from ..resilience.events import record_event
 from ..telemetry.reqtrace import RequestTracer
+from ..telemetry.slo import SloConfig, SloEngine
 from .replica import DEAD, HEALTH_RANK, Replica
 from .request import (DeadlineExceeded, SampleRequest, SampleResult,
                       SchedulerClosed, ServingFuture)
@@ -113,6 +127,9 @@ class FrontDoorConfig:
     hedge: `HedgePolicy`, or None to disable hedged retries.
     brownout: pool-wide degradation thresholds applied at the door
       against pool pressure, or None to disable.
+    slo: online error-budget engine config (telemetry/slo.py), or None
+      to disable per-tenant SLO accounting, burn-rate brownout shaping,
+      and the SLO routing penalty.
     """
     max_pending: int = 512
     max_attempts: int = 3
@@ -121,6 +138,8 @@ class FrontDoorConfig:
     hedge: Optional[HedgePolicy] = None
     brownout: Optional[BrownoutConfig] = dataclasses.field(
         default_factory=BrownoutConfig)
+    slo: Optional[SloConfig] = dataclasses.field(
+        default_factory=SloConfig)
 
 
 class ReplicaPool:
@@ -154,10 +173,15 @@ class ReplicaPool:
         return sum(r.scheduler.config.max_queue for r in self.replicas
                    if r.health() != DEAD)
 
-    def route(self, exclude: Set[str] = frozenset()
-              ) -> Optional[Replica]:
+    def route(self, exclude: Set[str] = frozenset(),
+              weigh=None) -> Optional[Replica]:
         """Least-loaded routable replica outside `exclude`, preferring
-        healthier classes; None when nothing is routable."""
+        healthier classes; None when nothing is routable. `weigh`
+        (optional, `callable(Replica) -> orderable`) inserts a penalty
+        between the health class and the load — the front door passes
+        its SLO engine's per-replica burn hint here, so a replica
+        burning its delivery objective ranks behind its peers WITHIN a
+        health class but never out-ranks health itself."""
         best: Optional[Tuple[tuple, Replica]] = None
         for r in self.replicas:
             if r.name in exclude:
@@ -165,7 +189,9 @@ class ReplicaPool:
             h = r.health()
             if h == DEAD:
                 continue
-            key = (HEALTH_RANK[h], r.load(), r.name)
+            key = (HEALTH_RANK[h],
+                   weigh(r) if weigh is not None else 0,
+                   r.load(), r.name)
             if best is None or key < best[0]:
                 best = (key, r)
         return best[1] if best else None
@@ -187,7 +213,7 @@ class _DoorReq:
 
     __slots__ = ("req", "req_eff", "fut", "trace", "t_sub", "flags",
                  "attempts", "tried", "arms", "hedged", "rounds",
-                 "degraded")
+                 "degraded", "t_open", "seg", "attempt_no")
 
     def __init__(self, req, req_eff, fut, trace, t_sub, flags):
         self.req = req
@@ -198,11 +224,18 @@ class _DoorReq:
         self.flags: Tuple[str, ...] = tuple(flags)
         self.attempts = 0           # failovers beyond the first route
         self.tried: Set[str] = set()
-        # each arm: {"rep": Replica, "fut": ServingFuture, "role": str}
+        # each arm: {"rep": Replica, "fut": ServingFuture, "role": str,
+        #            "t0": route timestamp (the door.hedge span start)}
         self.arms: List[Dict[str, Any]] = []
         self.hedged = False
         self.rounds = 0             # for the tracer's complete() row
         self.degraded: Tuple[str, ...] = ()
+        # open door-phase segment: [t_open, <next transition>) is a
+        # `door.<seg>` span; segments tile [t_sub, delivery] at shared
+        # timestamps so phase sums reconcile with latency exactly
+        self.t_open = t_sub
+        self.seg = "attempt"
+        self.attempt_no = 1
 
 
 class FrontDoor:
@@ -228,6 +261,8 @@ class FrontDoor:
         self.tracer = RequestTracer(telemetry, prefix="door")
         self.brownout = (BrownoutPolicy(self.config.brownout, telemetry)
                          if self.config.brownout is not None else None)
+        self.slo = (SloEngine(self.config.slo, telemetry)
+                    if self.config.slo is not None else None)
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -310,29 +345,44 @@ class FrontDoor:
             tr = self.tracer.begin(req, now)
             if len(self._entries) >= self.config.max_pending:
                 tel.counter("frontdoor/shed").inc()
-                self.tracer.shed(tr, "door_full", _now())
+                t_shed = _now()
+                self.tracer.shed(tr, "door_full", t_shed)
+                self._slo_request(req, now, t_shed, ok=False)
                 fut.set_exception(DeadlineExceeded(
                     f"front door queue full "
                     f"({self.config.max_pending})"))
                 return fut
             req_eff, flags = req, ()
             if self.brownout is not None:
-                tier = self.brownout.tier(self.pool.load(),
-                                          self.pool.capacity(), now)
+                tier = self.brownout.tier_for(
+                    req.tenant, self.pool.load(), self.pool.capacity(),
+                    now, slo=self.slo)
                 req_eff, flags = self.brownout.apply(req, tier)
                 if flags:
                     self.tracer.note(tr, "brownout", now, tier=tier,
                                      flags=list(flags))
-            target = self.pool.route()
+            target = self.pool.route(weigh=self._route_weigh())
             if target is None:
                 tel.counter("frontdoor/pool_exhausted").inc()
-                self.tracer.shed(tr, "pool_exhausted", _now())
+                record_event("pool_exhausted",
+                             "frontdoor.pool_exhausted",
+                             detail="no routable replica at admission")
+                t_shed = _now()
+                self.tracer.shed(tr, "pool_exhausted", t_shed)
+                self._slo_request(req, now, t_shed, ok=False)
                 fut.set_exception(ServingFault(
                     "no routable replica (pool dead)",
                     kind="pool_exhausted", request=req))
                 return fut
             e = _DoorReq(req, req_eff, fut, tr, now, flags)
             self._route_arm(e, target, role="primary", at=now)
+            # routing work (admission, brownout, route, hand-off to the
+            # replica) is the `door.route` phase; the first attempt
+            # segment opens at the SAME timestamp the route span closes
+            t_r = _now()
+            self.tracer.hop_span(tr, "door.route", now, t_r,
+                                 replica=target.name)
+            e.t_open = t_r
             self._entries.append(e)
             tel.gauge("frontdoor/pending").set(len(self._entries))
             self._cv.notify_all()
@@ -340,13 +390,53 @@ class FrontDoor:
 
     def _route_arm(self, e: _DoorReq, target: Replica, role: str,
                    at: float) -> None:
-        rf = target.submit(e.req_eff)
-        e.arms.append({"rep": target, "fut": rf, "role": role})
+        # trace propagation: the replica scheduler's tracer ADOPTS the
+        # door-minted id/lane (reqtrace.begin parent=), so one trace id
+        # spans door -> replica -> serving rounds for this request
+        rf = target.submit(e.req_eff,
+                           trace_ctx=self.tracer.context(e.trace))
+        e.arms.append({"rep": target, "fut": rf, "role": role,
+                       "t0": at})
         e.tried.add(target.name)
         self.telemetry.counter("frontdoor/routed").inc()
         self.tracer.note(e.trace, "route", at, replica=target.name,
                          role=role, health=target.health(),
                          load=target.load())
+
+    # -- SLO / span helpers ---------------------------------------------------
+    def _close_seg(self, e: _DoorReq, now: float, **args) -> None:
+        """Close the open door phase segment at `now` and open the next
+        one at the SAME timestamp — shared-timestamp tiling is what
+        makes the per-phase sums reconcile with latency_ms exactly."""
+        if e.trace is not None:
+            self.tracer.hop_span(e.trace, f"door.{e.seg}", e.t_open,
+                                 now, attempt=e.attempt_no, **args)
+        e.t_open = now
+
+    def _slo_request(self, req: SampleRequest, t_sub: float,
+                     now: float, ok: bool) -> None:
+        """Terminal tenant-budget outcome for one door request (shed,
+        fault, or delivery; delivery attains only within its `slo_ms`)."""
+        if self.slo is not None:
+            self.slo.observe(req.tenant, (now - t_sub) * 1e3, ok=ok,
+                             at_s=now, target_ms=req.slo_ms)
+
+    def _slo_replica(self, rep: Replica, t0: float, now: float,
+                     ok: bool, target_ms=None) -> None:
+        """Per-replica delivery series (tenant key `replica:<name>`):
+        the routing penalty's input, measured from the arm's own
+        routing timestamp."""
+        if self.slo is not None:
+            self.slo.observe(f"replica:{rep.name}", (now - t0) * 1e3,
+                             ok=ok, at_s=now, target_ms=target_ms)
+
+    def _route_weigh(self):
+        """Routing penalty callable for `ReplicaPool.route` (None when
+        the SLO engine is off): a replica burning its own delivery
+        objective ranks behind its peers within the same health class."""
+        if self.slo is None:
+            return None
+        return lambda r: self.slo.tier_hint(f"replica:{r.name}")
 
     # -- monitor --------------------------------------------------------------
     def _monitor_loop(self) -> None:
@@ -421,6 +511,8 @@ class FrontDoor:
         if e.req.deadline_s is not None \
                 and now - e.t_sub > e.req.deadline_s:
             self.telemetry.counter("frontdoor/shed").inc()
+            self._close_seg(e, now, outcome="deadline")
+            self._slo_request(e.req, e.t_sub, now, ok=False)
             self.tracer.shed(e.trace, "deadline", now)
             e.fut.set_exception(DeadlineExceeded(
                 f"deadline {e.req.deadline_s}s passed at the front "
@@ -451,6 +543,13 @@ class FrontDoor:
         """Cancel every still-queued arm of a finished entry; late
         results of uncancellable arms lose first-set-wins harmlessly."""
         for arm in e.arms:
+            if arm["role"] == "hedge":
+                # the overlapping span: hedge launch -> reap (the entry
+                # already resolved elsewhere); excluded from the tiling
+                # identity by name
+                self.tracer.hop_span(e.trace, "door.hedge", arm["t0"],
+                                     now, replica=arm["rep"].name,
+                                     outcome="lost")
             if not arm["fut"].done() and arm["rep"].cancel(arm["fut"]):
                 self.telemetry.counter("frontdoor/hedge_cancelled").inc()
                 self.tracer.note(e.trace, "hedge_cancel", now,
@@ -465,12 +564,18 @@ class FrontDoor:
         no arm is left). Returns True when the entry is finished."""
         e.arms.remove(arm)
         rep: Replica = arm["rep"]
+        if arm["role"] == "hedge":
+            self.tracer.hop_span(e.trace, "door.hedge", arm["t0"],
+                                 now, replica=rep.name,
+                                 outcome="failed")
         if isinstance(exc, ServingFault) \
                 and exc.kind in _TERMINAL_FAULT_KINDS:
             # the request's own deterministic fault — replaying it on
             # another replica reproduces it bit-exactly
             rep.note_outcome(True)   # not the replica's failure
             e.attempts = max(e.attempts, int(exc.attempts or 0))
+            self._close_seg(e, now)
+            self._slo_request(e.req, e.t_sub, now, ok=False)
             self.tracer.fail(e, f"fault:{exc.kind}", now)
             e.fut.set_exception(exc)
             self._reap_arms(e, now)
@@ -480,6 +585,8 @@ class FrontDoor:
             # true deadline expiry at the replica: the replica's clock
             # started at routing (>= door submit), so the budget is
             # gone everywhere — relay, don't failover
+            self._close_seg(e, now, outcome="deadline")
+            self._slo_request(e.req, e.t_sub, now, ok=False)
             self.tracer.shed(e.trace, "deadline", now)
             e.fut.set_exception(exc)
             self._reap_arms(e, now)
@@ -490,6 +597,8 @@ class FrontDoor:
             # lost without rebuild, scheduler/thread death, replica
             # killed, local queue full, hedge-loser cancel
             rep.note_outcome(False)
+            self._slo_replica(rep, arm["t0"], now, ok=False,
+                              target_ms=e.req.slo_ms)
             if self.brownout is not None:
                 self.brownout.note_fault(now)
             self.tracer.note(e.trace, "arm_failed", now,
@@ -497,11 +606,17 @@ class FrontDoor:
                              error=type(exc).__name__,
                              fault_kind=getattr(exc, "kind", None))
             if not e.arms:
+                # no live arm left: the attempt segment ends here and
+                # the (usually zero-width) failover segment opens
+                self._close_seg(e, now, replica=rep.name)
+                e.seg = "failover"
                 return self._failover(e, now)
             return False
         # anything else (bad-request prepare errors, programming
         # errors) is deterministic for the request — relay as-is
         rep.note_outcome(True)
+        self._close_seg(e, now)
+        self._slo_request(e.req, e.t_sub, now, ok=False)
         self.tracer.fail(e, f"error:{type(exc).__name__}", now)
         e.fut.set_exception(exc)
         self._reap_arms(e, now)
@@ -520,8 +635,9 @@ class FrontDoor:
                 kind="pool_exhausted", request=e.req,
                 attempts=e.attempts)
         else:
-            target = self.pool.route(exclude=e.tried) \
-                or self.pool.route()
+            weigh = self._route_weigh()
+            target = self.pool.route(exclude=e.tried, weigh=weigh) \
+                or self.pool.route(weigh=weigh)
             if target is None:
                 fault = ServingFault(
                     f"no routable replica left after {e.attempts} "
@@ -529,12 +645,23 @@ class FrontDoor:
                     request=e.req, attempts=e.attempts)
         if fault is not None:
             self.telemetry.counter("frontdoor/pool_exhausted").inc()
+            record_event("pool_exhausted", "frontdoor.pool_exhausted",
+                         detail=f"request failed after {e.attempts} "
+                                f"attempt(s)")
+            self._close_seg(e, now)
+            self._slo_request(e.req, e.t_sub, now, ok=False)
             self.tracer.fail(e, "fault:pool_exhausted", now)
             e.fut.set_exception(fault)
             return True
         self.telemetry.counter("frontdoor/failovers").inc()
         self.tracer.note(e.trace, "failover", now,
                          to=target.name, attempts=e.attempts)
+        # close the failover segment at the SAME `now` it opened on
+        # (zero-width on the common path: arm failure and re-route
+        # happen in one monitor scan) and open the next attempt
+        self._close_seg(e, now, to=target.name)
+        e.seg = "attempt"
+        e.attempt_no += 1
         self._route_arm(e, target, role="primary", at=now)
         return False
 
@@ -590,10 +717,20 @@ class FrontDoor:
             tel.counter("frontdoor/requests_ok").inc()
             tel.histogram("frontdoor/latency_ms",
                           bounds=MS_BUCKET_BOUNDS).observe(lat_ms)
+            # delivery closes the last attempt segment at the SAME
+            # `now` that produced lat_ms: route + attempts + failovers
+            # now tile [t_sub, now] and sum to lat_ms exactly
+            self._close_seg(e, now, replica=rep.name)
+            self._slo_request(e.req, e.t_sub, now, ok=True)
+            self._slo_replica(rep, arm["t0"], now, ok=True,
+                              target_ms=e.req.slo_ms)
             if arm["role"] == "hedge":
                 tel.counter("frontdoor/hedge_wins").inc()
                 self.tracer.note(e.trace, "hedge_win", now,
                                  replica=rep.name)
+                self.tracer.hop_span(e.trace, "door.hedge",
+                                     arm["t0"], now, replica=rep.name,
+                                     outcome="win")
             with self._lock:
                 self._lat.append(lat_ms)
             # door trace row: same three-way identity as the replica
@@ -611,10 +748,12 @@ class FrontDoor:
         close and the monitor crash guard. First set wins, so results
         a replica is delivering concurrently are never clobbered."""
         for e in self._entries:
+            t = _now()
+            self._close_seg(e, t, outcome="swept")
             if isinstance(exc, ServingFault):
-                self.tracer.fail(e, f"fault:{exc.kind}", _now())
+                self.tracer.fail(e, f"fault:{exc.kind}", t)
             else:
-                self.tracer.shed(e.trace, "closed", _now())
+                self.tracer.shed(e.trace, "closed", t)
             e.fut.set_exception(exc)
             for arm in e.arms:
                 arm["rep"].cancel(arm["fut"])

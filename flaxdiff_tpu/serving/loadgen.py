@@ -26,7 +26,9 @@ Two harnesses share that determinism contract:
 from __future__ import annotations
 
 import dataclasses
+import json
 import math
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -207,9 +209,63 @@ def build_open_loop(spec: OpenLoopSpec
         for offset in _tenant_arrivals(t, rng):
             template = dict(t.mix[int(rng.integers(len(t.mix)))])
             template.setdefault("seed", int(rng.integers(2 ** 31)))
+            # tenant attribution rides ON the request (accounting-only
+            # fields, never part of the engine group key): the door's
+            # online SLO engine charges the right error budget without
+            # any side-channel between loadgen and the door
+            template.setdefault("tenant", t.name)
+            template.setdefault("slo_ms", t.slo_ms)
             merged.append((offset, t.name, SampleRequest(**template)))
     merged.sort(key=lambda x: (x[0], x[1]))
     return merged
+
+
+TENANT_SLO_FILENAME = "tenant_slo.json"
+TENANT_SLO_SCHEMA_VERSION = 1
+
+
+def tenant_slo_summary(report: Dict[str, Any]) -> Dict[str, Any]:
+    """The diffable per-tenant core of an open-loop report: fixed key
+    set, sorted tenants, deterministic rounding — everything
+    `scripts/compare_runs.py` needs to say 'tenant A's attainment
+    regressed' across runs, and nothing timing-jittery."""
+    tenants: Dict[str, Any] = {}
+    for name in sorted(report.get("tenants", {})):
+        row = report["tenants"][name]
+        lat = row.get("latency_ms") or {}
+        att = row.get("slo_attainment")
+        tenants[name] = {
+            "requests": int(row.get("requests", 0)),
+            "completed": int(row.get("completed", 0)),
+            "shed": int(row.get("shed", 0)),
+            "faulted": int(row.get("faulted", 0)),
+            "errors": int(row.get("errors", 0)),
+            "slo_ms": row.get("slo_ms"),
+            "attainment": None if att is None else round(float(att), 6),
+            "p50_ms": (None if lat.get("p50") is None
+                       else round(float(lat["p50"]), 3)),
+            "p99_ms": (None if lat.get("p99") is None
+                       else round(float(lat["p99"]), 3)),
+        }
+    return {"schema_version": TENANT_SLO_SCHEMA_VERSION,
+            "tenants": tenants}
+
+
+def write_tenant_slo(report: Dict[str, Any], directory: str) -> str:
+    """Write the per-tenant SLO summary as a BYTE-STABLE artifact
+    (`tenant_slo.json`): sorted keys, fixed rounding, 2-space indent,
+    trailing newline, atomic rename. The same report serializes to the
+    same bytes every time (contract-tested), so artifact diffs only
+    ever show real attainment movement."""
+    doc = tenant_slo_summary(report)
+    payload = json.dumps(doc, sort_keys=True, indent=2) + "\n"
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, TENANT_SLO_FILENAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+    return path
 
 
 def _submit_worker(door, items, t0: float, speed: float, sink: list,
@@ -228,12 +284,15 @@ def _submit_worker(door, items, t0: float, speed: float, sink: list,
 def run_open_loop(door, spec: OpenLoopSpec, workers: int = 2,
                   speed: float = 1.0, timeout_s: float = 300.0,
                   workload: Optional[List[Tuple[float, str,
-                                                SampleRequest]]] = None
+                                                SampleRequest]]] = None,
+                  artifact_dir: Optional[str] = None
                   ) -> Dict[str, Any]:
     """Drive the merged tenant streams at the front door with
     `workers` open-loop submitter threads; wait for every future and
     report overall + per-tenant SLO attainment. Pass `workload` to
-    replay a pre-built (e.g. already-inspected) stream."""
+    replay a pre-built (e.g. already-inspected) stream;
+    `artifact_dir` additionally writes the byte-stable per-tenant
+    summary (`write_tenant_slo`) there."""
     if workload is None:
         workload = build_open_loop(spec)
     slo_by_tenant = {t.name: t.slo_ms for t in spec.tenants}
@@ -314,6 +373,8 @@ def run_open_loop(door, spec: OpenLoopSpec, workers: int = 2,
                 "slo_attainment": row["slo_attainment"],
                 "p50_ms": row["latency_ms"]["p50"],
                 "p99_ms": row["latency_ms"]["p99"]})
+    if artifact_dir is not None:
+        write_tenant_slo({"tenants": tenants}, artifact_dir)
     return {
         "requests": len(workload),
         "workers": n_workers,

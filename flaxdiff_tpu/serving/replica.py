@@ -111,8 +111,8 @@ class Replica:
         return self.scheduler.load()
 
     # -- lifecycle ------------------------------------------------------------
-    def submit(self, req):
-        return self.scheduler.submit(req)
+    def submit(self, req, trace_ctx=None):
+        return self.scheduler.submit(req, trace_ctx=trace_ctx)
 
     def prewarm(self, reqs):
         return self.scheduler.prewarm(reqs)

@@ -51,6 +51,12 @@ class SampleRequest:
     and then becomes part of the engine's group/program cache key, so
     requests with different effective plans never share a compiled
     program.
+
+    `tenant` and `slo_ms` are accounting-only fields: the front door's
+    SLO engine attributes the outcome (delivered within `slo_ms`?) to
+    the tenant's error budget, and burn-rate brownout degrades the
+    over-budget tenant first. Neither field is part of the engine group
+    key, so they never change batching or compiled programs.
     """
     num_samples: int = 1
     resolution: int = 64
@@ -65,6 +71,8 @@ class SampleRequest:
     use_ema: bool = True
     deadline_s: Optional[float] = None
     cache_plan: Optional[Any] = None    # ops.diffcache.CachePlan
+    tenant: Optional[str] = None
+    slo_ms: Optional[float] = None
 
     def __post_init__(self):
         if self.diffusion_steps < 1:

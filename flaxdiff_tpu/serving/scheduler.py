@@ -307,13 +307,17 @@ class ServingScheduler:
             return hit
 
     # -- admission ------------------------------------------------------------
-    def submit(self, req: SampleRequest) -> ServingFuture:
+    def submit(self, req: SampleRequest,
+               trace_ctx=None) -> ServingFuture:
         """Enqueue one request. Never blocks: overload and post-close
         submits come back as exceptions on the returned future.
         Brownout degradation applies here, at the admission door: under
         queue pressure or recent faults the request is downgraded (NFE
         cap, forced cache plan) instead of shed — the effective request
-        determines grouping, and the result carries the flags."""
+        determines grouping, and the result carries the flags.
+        `trace_ctx` (a `RequestTracer.context` dict) joins this hop's
+        spans to an upstream trace — the front door passes its minted
+        id so one trace spans door -> replica -> serving rounds."""
         fut = ServingFuture()
         tel = self.telemetry
         with self._cv:
@@ -322,7 +326,8 @@ class ServingScheduler:
                 return fut
             tel.counter("serving/requests_in").inc()
             t_sub = _now()
-            tr = self.tracer.begin(req, t_sub)   # None on disabled hub
+            tr = self.tracer.begin(req, t_sub,   # None on disabled hub
+                                   parent=trace_ctx)
             if len(self._queue) >= self.config.max_queue:
                 tel.counter("serving/shed").inc()
                 self.tracer.shed(tr, "queue_full", _now())

@@ -208,6 +208,31 @@ class BrownoutPolicy:
         self.telemetry.gauge("serving/brownout_tier").set(t)
         return t
 
+    def tier_for(self, tenant, queue_len: int, max_queue: int,
+                 now: float, slo=None) -> int:
+        """Per-tenant tier: the base `tier()` shaped by the tenant's
+        error-budget burn (docs/SERVING.md "Burn-rate brownout").
+
+        With no SLO engine or no tenant attribution this IS `tier()` —
+        the pre-SLO behavior, bit for bit. Otherwise the engine's
+        `tier_hint` escalates a burning tenant (it degrades first, up
+        to its hint), while a healthy tenant is SHIELDED one tier when
+        some other tenant is burning: the pressure that triggered the
+        base tier is attributed to the noisy neighbor, so the healthy
+        tenant should not pay full price for it. The fault floor is
+        never shielded away — device faults degrade everyone."""
+        base = self.tier(queue_len, max_queue, now)
+        if slo is None or tenant is None:
+            return base
+        hint = slo.tier_hint(tenant, now=now)
+        if hint > 0:
+            return max(base, hint)
+        if base > 0 and slo.any_burning(now=now):
+            floor = (self.config.fault_floor_tier
+                     if now < self._fault_until else 0)
+            return max(base - 1, floor)
+        return base
+
     def apply(self, req, tier: int) -> Tuple[Any, Tuple[str, ...]]:
         """Rewrite one request for `tier`; returns (effective request,
         degradation flags). Tier 0 returns the request untouched (the

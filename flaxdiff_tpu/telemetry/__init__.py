@@ -29,7 +29,20 @@ the ROADMAP depends on — you cannot speed up what you cannot attribute:
               one SampleRequest through admission, queue, every
               micro-batch round (program key, bucket, step codes),
               and completion; spans + request_trace JSONL rows with
-              zero added host syncs (counting-mock enforced)
+              zero added host syncs (counting-mock enforced). Trace
+              ids PROPAGATE across hops: the front door mints one and
+              the replica scheduler adopts it (`begin(parent=...)`),
+              so one Chrome lane shows door + replica + rounds
+  slo         SloEngine: online per-tenant SLO attainment and
+              multi-window error-budget burn rates from the same
+              timestamps the door already takes — the primary input
+              to burn-rate brownout and SLO-weighted routing
+  flightrec   FlightRecorder: bounded in-memory rings of recent trace
+              rows / resilience events / metric snapshots; a declared
+              incident (replica death, engine rebuild, pool
+              exhaustion, quarantine spike, elastic transition,
+              quorum eviction) dumps one correlated
+              incident-<id>.json bundle for offline diagnosis
   programs    ProgramRegistry: per-compiled-program evidence rows in
               programs.jsonl (cache key, compile ms, jaxpr FLOPs,
               cost_analysis flops/bytes, HBM peak, hardware
@@ -105,7 +118,14 @@ from .programs import (
     register_on_first_call,
     stable_json,
 )
+from .flightrec import (
+    BUNDLE_SCHEMA_VERSION,
+    INCIDENT_PREFIX,
+    FlightRecorder,
+    list_incidents,
+)
 from .reqtrace import RequestTrace, RequestTracer
+from .slo import SloConfig, SloEngine
 from .tracing import TraceRecorder
 
 __all__ = [
@@ -153,4 +173,10 @@ __all__ = [
     "stable_json",
     "RequestTrace",
     "RequestTracer",
+    "SloConfig",
+    "SloEngine",
+    "FlightRecorder",
+    "INCIDENT_PREFIX",
+    "BUNDLE_SCHEMA_VERSION",
+    "list_incidents",
 ]

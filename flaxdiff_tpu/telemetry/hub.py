@@ -32,6 +32,7 @@ import threading
 from typing import Dict, List, Optional
 
 from .aggregate import CrossHostAggregator
+from .flightrec import FlightRecorder
 from .goodput import GOODPUT_FILENAME, GoodputLedger
 from .metrics import (JsonlExporter, LoggerExporter, MetricsRegistry,
                       PrometheusTextfileExporter)
@@ -52,10 +53,22 @@ class Telemetry:
                  aggregator: Optional[CrossHostAggregator] = None,
                  enabled: Optional[bool] = None,
                  epoch: Optional[int] = None,
-                 programs: Optional[ProgramRegistry] = None):
+                 programs: Optional[ProgramRegistry] = None,
+                 flightrec: Optional["FlightRecorder"] = None):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.exporters = list(exporters)
         self.recorder = recorder
+        # fault flight recorder (telemetry/flightrec.py): None on the
+        # disabled hub — write_record/export forward into its rings
+        self.flightrec = flightrec
+        # bounded-trace drop accounting must hold for EVERY hub that
+        # carries a recorder, not only ones built via create(): a
+        # recorder handed in bare (tests, ad-hoc front-door hubs) gets
+        # the same counter wired here, so no lane can drop silently
+        if recorder is not None and not recorder.has_on_drop:
+            recorder.set_on_drop(
+                lambda n: self.registry.counter(
+                    "telemetry/trace_dropped_events").inc(n))
         self.goodput = goodput if goodput is not None else GoodputLedger()
         self.aggregator = aggregator
         # program evidence registry (telemetry/programs.py): None on
@@ -99,6 +112,12 @@ class Telemetry:
         if logger is not None:
             exporters.append(LoggerExporter(logger))
         registry = MetricsRegistry()
+        # fault flight recorder: rings fed by write_record/export below,
+        # resilience events via the CURRENT global event log (tests
+        # that scope a log with use_event_log attach their own)
+        flightrec = FlightRecorder(directory, registry=registry)
+        from ..resilience.events import global_event_log
+        flightrec.attach_events(global_event_log())
         return cls(
             registry=registry,
             exporters=exporters,
@@ -115,6 +134,7 @@ class Telemetry:
                         if transport is not None else None),
             programs=ProgramRegistry(_in_dir(PROGRAMS_FILENAME),
                                      registry=registry),
+            flightrec=flightrec,
             enabled=True)
 
     # -- instruments ---------------------------------------------------------
@@ -160,6 +180,8 @@ class Telemetry:
         epoch tag unless the caller already set one."""
         if "epoch" not in record:
             record = {**record, "epoch": self.epoch}
+        if self.flightrec is not None:
+            self.flightrec.record(record)
         for ex in self.exporters:
             ex.write(record)
 
@@ -195,6 +217,8 @@ class Telemetry:
         if extra:
             snap.update(extra)
         snap.setdefault("epoch", float(self.epoch))
+        if self.flightrec is not None:
+            self.flightrec.metrics(snap, step=step)
         for ex in self.exporters:
             ex.export(snap, step=step)
 
@@ -264,6 +288,8 @@ class Telemetry:
 
     def close(self) -> None:
         self.flush()
+        if self.flightrec is not None:
+            self.flightrec.close()
         for ex in self.exporters:
             ex.close()
 

@@ -52,24 +52,48 @@ class RequestTrace:
     dicts appended by the dispatch thread, emitted once at completion)."""
 
     __slots__ = ("trace_id", "seq", "submit_s", "summary", "rounds",
-                 "outcome", "events")
+                 "outcome", "events", "hop", "spans", "tid_fixed")
 
     def __init__(self, trace_id: str, seq: int, submit_s: float,
-                 summary: Dict[str, Any]):
+                 summary: Dict[str, Any], hop: str = "req",
+                 tid_fixed: Optional[int] = None):
         self.trace_id = trace_id
         self.seq = seq
         self.submit_s = submit_s
         self.summary = summary
+        # which hop of the serving path emitted this trace ("door",
+        # "r0", ... ). A propagated trace (see RequestTracer.begin
+        # `parent`) keeps the MINTING hop's trace id and lane but its
+        # own hop label, so one Chrome lane carries door + replica
+        # spans for the same request, each attributable.
+        self.hop = hop
+        self.tid_fixed = tid_fixed
         self.rounds: List[Dict[str, Any]] = []
         # recovery events (round_fault/requeued/quarantined/rebuild/
         # brownout, serving/supervision.py) — kept separate from
         # `rounds` so round_detail still counts dispatched rounds 1:1
         self.events: List[Dict[str, Any]] = []
+        # door phase spans (RequestTracer.hop_span): exact segments of
+        # the door timeline whose per-name sums land in the row's
+        # `phase_ms` and reconcile with latency_ms by construction
+        self.spans: List[Dict[str, Any]] = []
         self.outcome: Optional[str] = None
 
     @property
     def tid(self) -> int:
+        if self.tid_fixed is not None:
+            return self.tid_fixed
         return _REQ_TID_BASE + (self.seq % _REQ_TID_SPAN)
+
+
+def _phase_sums(spans: List[Dict[str, Any]]) -> Dict[str, float]:
+    """Per-span-name millisecond sums, UNROUNDED — the reconciliation
+    identity (non-hedge phases sum to latency_ms) must survive into
+    the JSONL row exactly as constructed."""
+    out: Dict[str, float] = {}
+    for s in spans:
+        out[s["span"]] = out.get(s["span"], 0.0) + s["ms"]
+    return dict(sorted(out.items()))
 
 
 def _req_summary(req) -> Dict[str, Any]:
@@ -104,17 +128,39 @@ class RequestTracer:
         return (self.telemetry is not None
                 and self.telemetry.recorder is not None)
 
+    def context(self, tr: Optional[RequestTrace]
+                ) -> Optional[Dict[str, Any]]:
+        """Portable trace context for cross-hop propagation: what the
+        front door hands `Replica.submit` so the replica scheduler's
+        spans join the door-minted trace (same id, same Chrome lane)."""
+        if tr is None:
+            return None
+        return {"trace_id": tr.trace_id, "tid": tr.tid}
+
     # -- lifecycle ----------------------------------------------------------
-    def begin(self, req, submit_s: float) -> Optional[RequestTrace]:
-        """Mint a trace at submit time; None on a disabled hub."""
+    def begin(self, req, submit_s: float,
+              parent: Optional[Dict[str, Any]] = None
+              ) -> Optional[RequestTrace]:
+        """Mint a trace at submit time; None on a disabled hub. With
+        `parent` (a `context()` dict propagated from an upstream hop)
+        the trace ADOPTS the parent's id and lane instead of minting —
+        one trace id then spans front door -> replica -> serving
+        rounds, and every span stays attributable via its `hop` arg."""
         if not self.enabled:
             return None
         seq = next(self._seq)
-        tr = RequestTrace(f"{self.prefix}-{self._pid}-{seq}", seq,
-                          submit_s, _req_summary(req))
+        if parent is not None:
+            tr = RequestTrace(str(parent["trace_id"]), seq, submit_s,
+                              _req_summary(req), hop=self.prefix,
+                              tid_fixed=parent.get("tid"))
+        else:
+            tr = RequestTrace(f"{self.prefix}-{self._pid}-{seq}", seq,
+                              submit_s, _req_summary(req),
+                              hop=self.prefix)
         self.telemetry.recorder.instant_at(
             "req.submit", submit_s, cat="serving",
-            args={"trace_id": tr.trace_id, **tr.summary}, tid=tr.tid)
+            args={"trace_id": tr.trace_id, "hop": tr.hop,
+                  **tr.summary}, tid=tr.tid)
         return tr
 
     def shed(self, tr: Optional[RequestTrace], reason: str,
@@ -131,7 +177,7 @@ class RequestTracer:
                            "outcome": tr.outcome}, tid=tr.tid)
         self.telemetry.write_record({
             "type": "request_trace", "trace_id": tr.trace_id,
-            "outcome": tr.outcome,
+            "hop": tr.hop, "outcome": tr.outcome,
             "queue_ms": (at_s - tr.submit_s) * 1e3, **tr.summary})
 
     def note(self, tr: Optional[RequestTrace], kind: str, at_s: float,
@@ -161,13 +207,34 @@ class RequestTracer:
                      args={"trace_id": tr.trace_id,
                            "outcome": outcome}, tid=tr.tid)
         row = {"type": "request_trace", "trace_id": tr.trace_id,
-               "outcome": outcome,
+               "hop": tr.hop, "outcome": outcome,
                "queue_ms": (at_s - tr.submit_s) * 1e3,
                "attempts": int(getattr(state, "attempts", 0)),
                **tr.summary}
+        if tr.spans:
+            row["phase_ms"] = _phase_sums(tr.spans)
         if tr.events:
             row["recovery"] = list(tr.events)
         self.telemetry.write_record(row)
+
+    def hop_span(self, tr: Optional[RequestTrace], name: str,
+                 t0_s: float, t1_s: float, **args) -> None:
+        """One door-phase span (`door.route` / `door.attempt` /
+        `door.failover` / `door.hedge`) on the request's lane. The
+        front door closes these at timestamps SHARED with the next
+        segment's open (and with the delivery timestamp that feeds the
+        `frontdoor/latency_ms` histogram), so the non-overlapping
+        phases tile [submit, delivery] exactly and the row's `phase_ms`
+        sums reconcile with latency_ms by construction. `door.hedge`
+        is the one overlapping span (a concurrent arm) — reported, but
+        excluded from the tiling identity."""
+        if tr is None or not self.enabled:
+            return
+        tr.spans.append({"span": name, "ms": (t1_s - t0_s) * 1e3,
+                         **args})
+        self.telemetry.recorder.event_at(
+            name, t0_s, t1_s, cat="serving",
+            args={"trace_id": tr.trace_id, **args}, tid=tr.tid)
 
     def rebuild(self, t0_s: float, t1_s: float,
                 args: Optional[Dict[str, Any]] = None) -> None:
@@ -231,17 +298,19 @@ class RequestTracer:
                      args={"trace_id": tr.trace_id}, tid=tr.tid)
         rec.event_at("req.serve", first_dispatch_s, ready_s,
                      cat="serving",
-                     args={"trace_id": tr.trace_id,
+                     args={"trace_id": tr.trace_id, "hop": tr.hop,
                            "compile_ms": round(compile_ms, 3),
                            "device_ms": round(device_ms, 3),
                            "rounds": int(state.rounds)}, tid=tr.tid)
         row = {
             "type": "request_trace", "trace_id": tr.trace_id,
-            "outcome": "ok",
+            "hop": tr.hop, "outcome": "ok",
             "queue_ms": queue_ms, "compile_ms": compile_ms,
             "device_ms": device_ms, "latency_ms": latency_ms,
             "rounds": int(state.rounds),
             "round_detail": list(tr.rounds), **tr.summary}
+        if tr.spans:
+            row["phase_ms"] = _phase_sums(tr.spans)
         # recovery provenance (serving/supervision.py): retried or
         # degraded completions say so in their own row
         attempts = int(getattr(state, "attempts", 0))

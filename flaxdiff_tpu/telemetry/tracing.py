@@ -51,6 +51,17 @@ class TraceRecorder:
         self.dropped = 0
         self._on_drop = on_drop
 
+    @property
+    def has_on_drop(self) -> bool:
+        return self._on_drop is not None
+
+    def set_on_drop(self, fn) -> None:
+        """Late-wire the drop callback: a recorder handed to a
+        `Telemetry` hub bare (not via `Telemetry.create`) gets the
+        `telemetry/trace_dropped_events` counter attached here, so
+        front-door and scheduler lanes share one accounting path."""
+        self._on_drop = fn
+
     def _now_us(self) -> float:
         return (self._clock() - self._t0) * 1e6
 

@@ -15,7 +15,14 @@ Inputs (auto-detected per argument):
   aggregated `request_trace` latency decomposition, and the program
   registry (`programs.jsonl`) row by row — per-program compile ms and
   FLOPs line up by (kind, key), so "this program got slower to build"
-  and "this program changed shape" are separate findings.
+  and "this program changed shape" are separate findings. Two ISSUE 18
+  artifacts ride along when present: the byte-stable per-tenant SLO
+  summary (`tenant_slo.json`, loadgen's `write_tenant_slo`) diffs as a
+  `tenant_slo` stage where attainment DOWN is worse, and flight-
+  recorder `incident-*.json` bundles diff as per-kind counts in an
+  `incidents` stage where ANY increase is a regression (counts, not
+  percentages — one new replica_lost incident is a finding even from a
+  zero base).
 - a **bench result file** (the final JSON line of `bench.py`, e.g.
   `BENCH_r05.json`): compares numeric leaves per stage.
 
@@ -47,6 +54,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import sys
@@ -60,17 +68,19 @@ if _REPO_ROOT not in sys.path:
 # and the full path, lowercase)
 _UP_IS_WORSE = ("_ms", "latency", "_s", "p50", "p99", "max", "mean",
                 "compile", "re_traces", "shed", "dropped", "wall",
-                "step_time", "bytes")
+                "step_time", "bytes", "incident", "faulted", "errors",
+                "burn")
 _DOWN_IS_WORSE = ("speedup", "throughput", "imgs_per_sec", "mfu",
                   "hit_rate", "fraction", "psnr", "occupancy",
-                  "samples_per_s", "goodput", "rps")
+                  "samples_per_s", "goodput", "rps", "attainment")
 # pure identity/config numbers: never a finding in either direction
 # (flops is here too: a FLOPs change means the PROGRAM changed shape —
 # report it, but it is a different experiment, not a regression)
 _NEUTRAL = ("seed", "count", "n_requests", "rate_hz", "batch", "steps",
             "rounds", "requests", "completed", "incarnation", "epoch",
             "devices", "world", "num_", "resolution", "nfe", "secs",
-            "budget", "attempts", "image_size", "flops")
+            "budget", "attempts", "image_size", "flops", "slo_ms",
+            "schema_version")
 # neutral checked on the FULL path (before the generic "bytes"-is-worse
 # heuristic): the static comm model (`collectives`,
 # `comm_bytes_by_axis/<axis>`) describes the PROGRAM, not the run — a
@@ -181,6 +191,36 @@ def load_telemetry_dir(path: str) -> Dict[str, Any]:
             agg[f"{span}/p50"] = _pct(xs, 0.5)
             agg[f"{span}/p99"] = _pct(xs, 0.99)
         stages["request_traces"] = _flatten(agg)
+    # per-tenant SLO artifact (loadgen's write_tenant_slo): attainment
+    # DOWN is worse, per-tenant p50/p99 UP is worse
+    slo_path = os.path.join(path, "tenant_slo.json")
+    if os.path.exists(slo_path):
+        try:
+            with open(slo_path, "r", encoding="utf-8") as f:
+                slo_doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            slo_doc = {}
+        tenants = slo_doc.get("tenants")
+        if isinstance(tenants, dict) and tenants:
+            stages["tenant_slo"] = _flatten(tenants)
+    # flight-recorder bundles: per-kind incident counts (always
+    # emitted, so a base with zero bundles still compares — the
+    # candidate growing ANY kind from 0 is the finding)
+    counts: Dict[str, float] = {"total": 0.0}
+    for inc_path in sorted(glob.glob(
+            os.path.join(path, "incident-*.json"))):
+        try:
+            with open(inc_path, "r", encoding="utf-8") as f:
+                inc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        kind = str(inc.get("kind") or "unknown")
+        counts["total"] += 1.0
+        counts[kind] = counts.get(kind, 0.0) + 1.0
+    # keys carry the incidents/ prefix so direction() classifies them
+    # (stage rows are compared by bare key, without the stage name)
+    stages["incidents"] = {f"incidents/{k}": v
+                           for k, v in counts.items()}
     fp: Dict[str, Any] = {}
     programs: Dict[str, Dict[str, float]] = {}
     from flaxdiff_tpu.telemetry.programs import (PROGRAMS_FILENAME,
@@ -269,6 +309,13 @@ def build_report(base_path: str, cand_path: str, threshold: float,
         th = stage_thresholds.get(name, threshold)
         rows = compare_stage(base["stages"][name], cand["stages"][name],
                              th)
+        if name == "incidents":
+            # counts, not percentages: one more replica_lost bundle is
+            # a regression even from a zero base (where relative delta
+            # is undefined and the generic threshold never fires)
+            for r in rows:
+                if r["direction"] == "up_is_worse":
+                    r["regressed"] = r["candidate"] > r["base"]
         report["stages"][name] = {"threshold": th, "rows": rows}
         for r in rows:
             if r["regressed"]:
@@ -326,10 +373,11 @@ def render_text(report: Dict[str, Any]) -> str:
                      f"threshold {st['threshold']:.0%}) ==")
         for r in (flagged or moved[:8]):
             mark = "REGRESSION" if r["regressed"] else "improved"
+            pct = ("new" if r["delta_pct"] is None
+                   else f"{r['delta_pct']:+.1f}%")
             lines.append(
                 f"  {r['metric']:<44s} {r['base']:>12.4g} -> "
-                f"{r['candidate']:>12.4g}  ({r['delta_pct']:+.1f}%) "
-                f"{mark}")
+                f"{r['candidate']:>12.4g}  ({pct}) {mark}")
         if not flagged and not moved:
             lines.append("  (no movement beyond threshold)")
     progs = report.get("programs")

@@ -26,10 +26,15 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import sys
 from typing import Dict, List, Optional
+
+# --json output contract: bump when top-level keys change shape or
+# meaning (tests pin the key set against this version)
+REPORT_SCHEMA_VERSION = 1
 
 
 def _percentile(xs: List[float], q: float) -> float:
@@ -359,6 +364,99 @@ def frontdoor_section(metrics: List[Dict], health: List[Dict],
     lines.append("")
 
 
+def slo_section(metrics: List[Dict], lines: List[str]) -> None:
+    """Online SLO engine state (telemetry/slo.py): per-tenant
+    attainment and error-budget burn gauges from the last snapshot —
+    the live inputs burn-rate brownout and SLO routing acted on."""
+    last = metrics[-1] if metrics else {}
+    tenants = sorted({k[len("slo/attainment/"):] for k in last
+                      if k.startswith("slo/attainment/")})
+    if not tenants:
+        return
+    lines.append("== SLO budgets (last snapshot) ==")
+
+    def g(name: str, default=0.0):
+        v = last.get(name, default)
+        return float(v) if isinstance(v, (int, float)) else default
+
+    observed, violations = g("slo/observed"), g("slo/violations")
+    if observed:
+        lines.append(f"observed:           {observed:.0f} outcomes, "
+                     f"{violations:.0f} violations "
+                     f"({violations / observed:.1%})")
+    lines.append(f"{'tenant':<20s} {'attain':>8s} {'burn fast':>10s} "
+                 f"{'burn slow':>10s}")
+    for t in tenants:
+        burning = (g(f"slo/burn_fast/{t}") >= 1.0
+                   and g(f"slo/burn_slow/{t}") >= 1.0)
+        lines.append(f"{t:<20s} {g(f'slo/attainment/{t}'):>8.1%} "
+                     f"{g(f'slo/burn_fast/{t}'):>10.2f} "
+                     f"{g(f'slo/burn_slow/{t}'):>10.2f}"
+                     + ("  <- BURNING" if burning else ""))
+    lines.append("")
+
+
+def read_incidents(directory: str) -> List[Dict]:
+    """Flight-recorder incident bundles (`incident-*.json`,
+    telemetry/flightrec.py) next to the telemetry stream."""
+    out: List[Dict] = []
+    for path in sorted(glob.glob(
+            os.path.join(directory, "incident-*.json"))):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                bundle = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(bundle, dict):
+            bundle["_file"] = os.path.basename(path)
+            out.append(bundle)
+    return out
+
+
+def incident_summaries(incidents: List[Dict]) -> List[Dict]:
+    """The --json view of the bundles: identity + cross-reference
+    counts, not the full rings (those live in the bundle files)."""
+    return [{
+        "file": b.get("_file"),
+        "incident_id": b.get("incident_id"),
+        "kind": b.get("kind"),
+        "detail": b.get("detail"),
+        "t_s": b.get("t_s"),
+        "step": b.get("step"),
+        "records": len(b.get("records") or []),
+        "ledger": len(b.get("ledger") or []),
+        "trace_ids": len(b.get("trace_ids") or []),
+        "suppressed_since_last": b.get("suppressed_since_last", 0),
+    } for b in incidents]
+
+
+def incidents_section(incidents: List[Dict], lines: List[str]) -> None:
+    """Declared incidents: one line per bundle plus the tail of its
+    operational ledger, so the post-mortem starts from the report."""
+    if not incidents:
+        return
+    lines.append(f"== Incidents ({len(incidents)} bundle(s)) ==")
+    for b in incidents:
+        extra = ""
+        supp = int(b.get("suppressed_since_last", 0) or 0)
+        if supp:
+            extra = f"  ({supp} suppressed since previous)"
+        lines.append(
+            f"{str(b.get('incident_id', '?')):<26s} "
+            f"t={float(b.get('t_s', 0.0)):>9.2f}s  "
+            f"records={len(b.get('records') or []):>4d}  "
+            f"ledger={len(b.get('ledger') or []):>3d}  "
+            f"traces={len(b.get('trace_ids') or []):>4d}{extra}")
+        if b.get("detail"):
+            lines.append(f"    {str(b['detail'])[:96]}")
+        for ev in (b.get("ledger") or [])[-3:]:
+            lines.append(
+                f"    ledger: {ev.get('kind', '?')}@"
+                f"{ev.get('site', '?')} "
+                f"{str(ev.get('detail', ''))[:72]}")
+    lines.append("")
+
+
 def data_health_section(metrics: List[Dict], quarantines: List[Dict],
                         breakers: List[Dict], skews: List[Dict],
                         lines: List[str]) -> None:
@@ -613,9 +711,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                             if k.startswith("goodput/reclaimed/")},
         }
 
+    incidents = read_incidents(directory)
+
     if args.json:
         wall = sum(float(r.get("wall", 0.0)) for r in steps)
-        doc = {"goodput": goodput,
+        doc = {"schema_version": REPORT_SCHEMA_VERSION,
+               "goodput": goodput,
                # max step number, not row count: under sampled phase
                # timing rows are per-window
                "steps": int(max((float(r.get("step", 0))
@@ -640,6 +741,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                    "counters": {k: v for k, v in
                                 (metrics[-1] if metrics else {}).items()
                                 if k.startswith("frontdoor/")}},
+               "slo": {k: v for k, v in
+                       (metrics[-1] if metrics else {}).items()
+                       if k.startswith("slo/")},
+               "incidents": incident_summaries(incidents),
                "data_health": {
                    "quarantine": quarantines,
                    "breaker_timeline": breakers,
@@ -678,6 +783,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     pod_section(pods, lines)
     serving_section(metrics, lines)
     frontdoor_section(metrics, fd_health, tenant_slo, lines)
+    slo_section(metrics, lines)
+    incidents_section(incidents, lines)
     data_health_section(metrics, quarantines, breakers, skews, lines)
     reqtrace_section(reqtraces, lines)
     programs_section(programs, lines)

@@ -436,11 +436,17 @@ def test_door_traces_and_health_timeline(tmp_path):
     tel.close()
     recs = [json.loads(line) for line in
             (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+    # trace PROPAGATION (ISSUE 18): the replica scheduler adopts the
+    # door-minted id, so every request_trace row carries the door's
+    # trace id and rows are told apart by their `hop` instead
     door_rows = [r for r in recs if r.get("type") == "request_trace"
-                 and r["trace_id"].startswith("door-")]
+                 and r["hop"] == "door"]
     rep_rows = [r for r in recs if r.get("type") == "request_trace"
-                and not r["trace_id"].startswith("door-")]
+                and r["hop"] != "door"]
     assert len(door_rows) == 2 and len(rep_rows) == 2
+    assert all(r["trace_id"].startswith("door-") for r in rep_rows)
+    assert ({r["trace_id"] for r in rep_rows}
+            == {r["trace_id"] for r in door_rows})
     for t in door_rows:
         assert t["outcome"] == "ok"
         kinds = [e["event"] for e in t["recovery"]]
@@ -448,6 +454,13 @@ def test_door_traces_and_health_timeline(tmp_path):
         # door-scope identity: queue + compile + device == latency
         total = t["queue_ms"] + t["compile_ms"] + t["device_ms"]
         assert total == pytest.approx(t["latency_ms"], abs=0.5)
+        # door-phase tiling: route + attempts + failovers == latency
+        # EXACTLY (shared timestamps; hedge is excluded by name)
+        phases = t["phase_ms"]
+        tiled = sum(ms for name, ms in phases.items()
+                    if name != "door.hedge")
+        assert tiled == pytest.approx(t["latency_ms"], abs=1e-6)
+        assert "door.route" in phases and "door.attempt" in phases
     health = [r for r in recs if r.get("type") == "frontdoor_health"]
     assert {h["replica"] for h in health} >= {"r0", "r1"}
     assert any(h["replica"] == "r0" and h["health"] == "dead"

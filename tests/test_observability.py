@@ -1,0 +1,517 @@
+"""Incident-grade observability suite (ISSUE 18,
+docs/OBSERVABILITY.md "SLO engine" / "Flight recorder" /
+"Trace propagation").
+
+Acceptance bars enforced here:
+- the online SLO engine computes sliding-window attainment and
+  two-window error-budget burn rates incrementally, and its
+  `tier_hint` only degrades a tenant when BOTH windows burn;
+- `BrownoutPolicy.tier_for` escalates an over-budget tenant and
+  SHIELDS healthy tenants from a noisy neighbor's pressure — but
+  never shields away the device-fault floor;
+- at the front door, an over-budget tenant's requests degrade
+  (burn-rate brownout) while a healthy tenant's pass untouched;
+- the flight recorder dumps one cross-referenced incident bundle per
+  declared incident, with per-kind cooldown + run cap suppression
+  counted, and the Telemetry hub wires it end to end;
+- door phase spans tile [submit, delivery] exactly: their sum
+  reconciles with the `frontdoor/latency_ms` histogram total;
+- loadgen's per-tenant SLO artifact is byte-stable with a pinned key
+  set; `scripts/compare_runs.py` flags attainment drops (down =
+  worse) and new incident bundles (any increase = worse);
+- `scripts/diagnose_run.py --json` carries `schema_version` with a
+  pinned top-level key set and renders SLO + Incidents sections.
+"""
+import json
+
+import pytest
+
+from flaxdiff_tpu.resilience.events import (EventLog, record_event,
+                                            use_event_log)
+from flaxdiff_tpu.serving import (FrontDoor, FrontDoorConfig, Replica,
+                                  ReplicaPool, SampleRequest,
+                                  SchedulerConfig, ServingScheduler)
+from flaxdiff_tpu.serving.supervision import (BrownoutConfig,
+                                              BrownoutPolicy)
+from flaxdiff_tpu.telemetry import Telemetry
+from flaxdiff_tpu.telemetry.flightrec import (BUNDLE_SCHEMA_VERSION,
+                                              FlightRecorder,
+                                              list_incidents)
+from flaxdiff_tpu.telemetry.slo import SloConfig, SloEngine
+from tests.test_serving import FakeEngine
+
+
+def _replica(name, tel, delay=0.0, **cfg_kwargs):
+    eng = FakeEngine(step_delay_s=delay)
+    cfg_kwargs = {"round_steps": 4, "batch_buckets": (2,), **cfg_kwargs}
+    sched = ServingScheduler(engine=eng, config=SchedulerConfig(
+        **cfg_kwargs), telemetry=tel, autostart=True)
+    return Replica(name, sched), eng
+
+
+def _door(replicas, tel, **door_kwargs):
+    return FrontDoor(ReplicaPool(replicas), telemetry=tel,
+                     config=FrontDoorConfig(**door_kwargs))
+
+
+# ---------------------------------------------------------------------------
+# SLO engine (telemetry/slo.py)
+# ---------------------------------------------------------------------------
+
+def test_slo_sliding_windows_attainment_and_burn():
+    """Attainment and burn rates are computed over a fast and a slow
+    sliding window from caller-supplied timestamps; misses age out of
+    the fast window first, then out of the slow one."""
+    tel = Telemetry(enabled=False)
+    eng = SloEngine(SloConfig(target_ms=100.0, objective=0.9,
+                              fast_window_s=10.0, slow_window_s=100.0),
+                    tel)
+    t0 = 1000.0
+    for i in range(8):
+        assert eng.observe("a", 50.0, ok=True, at_s=t0 + i) is True
+    assert eng.attainment("a", now=t0 + 8) == 1.0
+    assert eng.burn_rates("a", now=t0 + 8) == (0.0, 0.0)
+    # two misses: one over-latency success, one outright failure
+    assert eng.observe("a", 500.0, ok=True, at_s=t0 + 8) is False
+    assert eng.observe("a", 50.0, ok=False, at_s=t0 + 9) is False
+    assert eng.attainment("a", now=t0 + 9) == pytest.approx(0.8)
+    fast, slow = eng.burn_rates("a", now=t0 + 9)
+    assert fast == pytest.approx(2.0)     # (1 - 0.8) / (1 - 0.9)
+    assert slow == pytest.approx(2.0)
+    # the misses age OUT of the fast window but stay in the slow one
+    fast, slow = eng.burn_rates("a", now=t0 + 25)
+    assert fast == 0.0 and slow == pytest.approx(2.0)
+    # ... and eventually out of the slow window too
+    assert eng.burn_rates("a", now=t0 + 200) == (0.0, 0.0)
+    # per-request objective beats the engine default
+    assert eng.observe("b", 150.0, at_s=t0, target_ms=200.0) is True
+    assert eng.observe("b", 150.0, at_s=t0) is False
+    # exported series (None tenant buckets under "default")
+    eng.observe(None, 1.0, at_s=t0)
+    snap = tel.registry.snapshot()
+    assert snap["slo/attainment/default"] == 1.0
+    assert snap["slo/observed"] == 13.0
+    assert snap["slo/violations"] == 3.0
+    assert "slo/burn_fast/a" in snap and "slo/burn_slow/a" in snap
+
+
+def test_slo_tier_hint_needs_both_windows_and_exhaust_escalates():
+    """A fast-window spike alone never degrades anyone (tier 0); both
+    windows over budget is tier 1; a fast burn at `exhaust_factor`x
+    budget rate is tier 2. `any_burning` goes True with the first
+    over-budget tenant."""
+    tel = Telemetry(enabled=False)
+    cfg = SloConfig(objective=0.9, fast_window_s=10.0,
+                    slow_window_s=100.0, exhaust_factor=4.0)
+    eng = SloEngine(cfg, tel)
+    t0 = 500.0
+    assert eng.tier_hint("ghost", now=t0) == 0      # unobserved
+    assert eng.tier_hint(None, now=t0) == 0
+    assert eng.any_burning(now=t0) is False
+    # slow window burning, fast window clean -> NOT degraded: the
+    # two-window AND means a past outage alone never keeps degrading
+    for i in range(4):
+        eng.observe("past", 1e9, ok=False, at_s=t0 + i)
+    for i in range(16):
+        eng.observe("past", 1.0, ok=True, at_s=t0 + 40 + i * 0.5)
+    now = t0 + 48
+    fast, slow = eng.burn_rates("past", now=now)
+    assert fast == 0.0 and slow >= 1.0
+    assert eng.tier_hint("past", now=now) == 0
+    # both windows moderately over budget -> tier 1
+    for i in range(8):
+        eng.observe("warm", 1.0, ok=True, at_s=t0 + i)
+    for i in range(2):
+        eng.observe("warm", 1e9, ok=False, at_s=t0 + 8 + i)
+    fast, slow = eng.burn_rates("warm", now=t0 + 9)
+    assert 1.0 <= fast < 4.0 and slow >= 1.0
+    assert eng.tier_hint("warm", now=t0 + 9) == 1
+    # total failure -> fast burn 10x budget rate -> tier 2 (exhausted)
+    for i in range(6):
+        eng.observe("dead", 1e9, ok=False, at_s=t0 + i)
+    assert eng.tier_hint("dead", now=t0 + 6) == 2
+    assert eng.any_burning(now=t0 + 9) is True
+    snap = eng.snapshot(now=t0 + 9)
+    assert set(snap) == {"past", "warm", "dead"}
+    assert set(snap["warm"]) == {"attainment", "burn_fast", "burn_slow",
+                                 "samples"}
+
+
+def test_slo_ring_bound_keeps_counts_consistent():
+    """The per-tenant sample ring is bounded: evicted samples leave
+    the window counts, so attainment stays a true fraction of what is
+    actually retained."""
+    tel = Telemetry(enabled=False)
+    eng = SloEngine(SloConfig(objective=0.9, fast_window_s=1000.0,
+                              slow_window_s=1000.0, max_samples=8), tel)
+    t0 = 10.0
+    for i in range(8):
+        eng.observe("t", 1e9, ok=False, at_s=t0 + i)    # fill with bad
+    for i in range(8):
+        eng.observe("t", 1.0, ok=True, at_s=t0 + 8 + i)  # evict them
+    assert eng.attainment("t", now=t0 + 16) == 1.0
+    assert eng.burn_rates("t", now=t0 + 16) == (0.0, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# burn-rate brownout shaping (supervision.tier_for)
+# ---------------------------------------------------------------------------
+
+def test_tier_for_escalates_burning_and_shields_healthy():
+    tel = Telemetry(enabled=False)
+    pol = BrownoutPolicy(BrownoutConfig(queue_soft=0.5,
+                                        queue_heavy=0.75,
+                                        queue_critical=0.9), tel)
+    eng = SloEngine(SloConfig(objective=0.9, fast_window_s=10.0,
+                              slow_window_s=100.0), tel)
+    t0 = 100.0
+    for i in range(10):
+        eng.observe("noisy", 1e9, ok=False, at_s=t0 + i)
+        eng.observe("quiet", 1.0, ok=True, at_s=t0 + i)
+    now = t0 + 10
+    # no engine / no tenant attribution: bit-for-bit the base tier
+    assert pol.tier_for("noisy", 6, 10, now, slo=None) \
+        == pol.tier(6, 10, now) == 1
+    assert pol.tier_for(None, 6, 10, now, slo=eng) == 1
+    # a burning tenant escalates to its hint even on an idle queue
+    assert eng.tier_hint("noisy", now=now) == 2
+    assert pol.tier_for("noisy", 0, 10, now, slo=eng) == 2
+    assert pol.tier_for("noisy", 6, 10, now, slo=eng) == 2
+    # the healthy tenant is shielded one tier while a neighbor burns:
+    # the queue pressure is the noisy tenant's doing, not theirs
+    assert pol.tier_for("quiet", 6, 10, now, slo=eng) == 0
+    # ... but the device-fault floor is never shielded away
+    pol.note_fault(now)
+    assert pol.tier_for("quiet", 6, 10, now, slo=eng) == 1
+
+
+def test_door_burn_rate_brownout_degrades_over_budget_tenant_only():
+    """The front-door acceptance bar: with ZERO queue pressure, an
+    over-budget tenant's requests are degraded (nfe-capped) purely by
+    its burn rate, while a healthy tenant's requests pass untouched."""
+    tel = Telemetry(enabled=False)
+    (r0, _), = (_replica("r0", tel),)
+    door = _door([r0], tel,
+                 brownout=BrownoutConfig(queue_soft=5.0, queue_heavy=6.0,
+                                         queue_critical=7.0, nfe_cap=4,
+                                         force_plan=None),
+                 slo=SloConfig(objective=0.9, fast_window_s=30.0,
+                               slow_window_s=300.0))
+    for _ in range(12):                       # budget exhausted
+        door.slo.observe("overbudget", 1e9, ok=False)
+    for _ in range(12):                       # inside budget
+        door.slo.observe("healthy", 1.0, ok=True)
+    assert door.slo.tier_hint("overbudget") == 2
+    out_hot = door.submit(SampleRequest(
+        resolution=8, diffusion_steps=16, sampler="ddim", seed=1,
+        tenant="overbudget")).result(timeout=30)
+    out_cold = door.submit(SampleRequest(
+        resolution=8, diffusion_steps=16, sampler="ddim", seed=2,
+        tenant="healthy")).result(timeout=30)
+    door.close()
+    assert "nfe_capped" in out_hot.degraded
+    assert out_cold.degraded == ()
+    snap = tel.registry.snapshot()
+    assert snap["slo/burn_fast/overbudget"] >= 4.0
+    # delivery feeds the per-replica series SLO routing weighs
+    assert snap["slo/attainment/replica:r0"] == 1.0
+
+
+def test_slo_routing_weight_prefers_unburned_replica():
+    """`ReplicaPool.route(weigh=)`: among equally healthy, equally
+    loaded replicas, the one whose `replica:<name>` SLO series burns
+    is routed AWAY from."""
+    tel = Telemetry(enabled=False)
+    (r0, _), (r1, _) = _replica("r0", tel), _replica("r1", tel)
+    door = _door([r0, r1], tel)
+    for _ in range(10):
+        door.slo.observe("replica:r0", 1e9, ok=False)
+    weigh = door._route_weigh()
+    assert weigh(r0) > weigh(r1)
+    assert door.pool.route(weigh=weigh).name == "r1"
+    door.close()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder (telemetry/flightrec.py)
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_flightrec_bundle_contents_and_cross_references(tmp_path):
+    clk = _Clock()
+    log = EventLog("flightrec-test")
+    rec = FlightRecorder(str(tmp_path), clock=clk)
+    rec.attach_events(log)
+    rec.record({"type": "request_trace", "trace_id": "door-1-0",
+                "outcome": "ok"})
+    rec.metrics({"frontdoor/requests_ok": 3.0}, step=7)
+    clk.t = 1.0
+    log.record("replica_lost", "chaos.site", detail="killed r0",
+               step=12)
+    rec.close()
+    paths = rec.incidents
+    assert len(paths) == 1 and "replica_lost" in paths[0]
+    assert list_incidents(str(tmp_path)) == paths
+    with open(paths[0], "r", encoding="utf-8") as f:
+        bundle = json.load(f)
+    assert bundle["schema_version"] == BUNDLE_SCHEMA_VERSION
+    assert bundle["kind"] == "replica_lost"
+    assert bundle["incident_id"] == "001-replica_lost"
+    assert bundle["detail"] == "chaos.site: killed r0"
+    # cross-reference indices: trace ids from the rows, steps from
+    # rows + ledger, all three rings captured
+    assert bundle["trace_ids"] == ["door-1-0"]
+    assert bundle["steps"] == [12]
+    assert len(bundle["records"]) == 1
+    assert len(bundle["ledger"]) == 1
+    assert len(bundle["metric_snapshots"]) == 1
+    # closed recorder no longer hears the log
+    log.record("replica_lost", "after.close")
+    assert len(rec.incidents) == 1
+
+
+def test_flightrec_cooldown_cap_and_suppression_counting(tmp_path):
+    clk = _Clock()
+    rec = FlightRecorder(str(tmp_path), cooldown_s=5.0,
+                         max_incidents=3, clock=clk)
+    assert rec.incident("replica_lost", "a") is not None
+    clk.t = 1.0
+    assert rec.incident("replica_lost", "b") is None    # cooldown
+    clk.t = 2.0
+    p = rec.incident("engine_rebuild", "c")     # new kind: not cooled
+    assert p is not None
+    with open(p, "r", encoding="utf-8") as f:
+        # the NEXT bundle of any kind carries the suppression count
+        assert json.load(f)["suppressed_since_last"] == 1
+    clk.t = 10.0
+    assert rec.incident("replica_lost", "d") is not None
+    clk.t = 20.0                                # run cap reached
+    assert rec.incident("pool_exhausted", "e") is None
+    assert len(list_incidents(str(tmp_path))) == 3
+
+
+def test_flightrec_quarantine_spike_and_row_incidents(tmp_path):
+    clk = _Clock()
+    log = EventLog("spike-test")
+    rec = FlightRecorder(str(tmp_path), quarantine_spike=3,
+                         cooldown_s=0.5, clock=clk)
+    rec.attach_events(log)
+    log.record("quarantine", "data.src", detail="bad record")
+    log.record("quarantine", "data.src", detail="bad record")
+    assert rec.incidents == []              # routine, not an incident
+    log.record("quarantine", "data.src", detail="bad record")
+    assert any("quarantine_spike" in p for p in rec.incidents)
+    # row-typed incident: an elastic transition arriving as telemetry
+    clk.t = 5.0
+    rec.record({"type": "elastic_transition", "reason": "scale_down"})
+    assert any("elastic_transition" in p for p in rec.incidents)
+    rec.close()
+
+
+def test_hub_wires_flightrec_and_counts_incidents(tmp_path):
+    """`Telemetry.create` builds the recorder, forwards rows/exports,
+    and subscribes it to the global event log; `close` detaches it."""
+    log = EventLog("hub-test")
+    with use_event_log(log):
+        tel = Telemetry.create(str(tmp_path))
+        assert tel.flightrec is not None
+        tel.write_record({"type": "request_trace", "trace_id": "x-1",
+                          "outcome": "ok"})
+        record_event("replica_lost", "chaos.test", detail="r0 down")
+        assert tel.registry.snapshot()["telemetry/incidents"] == 1.0
+        tel.close()
+    paths = list_incidents(str(tmp_path))
+    assert len(paths) == 1
+    with open(paths[0], "r", encoding="utf-8") as f:
+        assert "x-1" in json.load(f)["trace_ids"]
+    log.record("replica_lost", "after.close")   # detached: no dump
+    assert len(list_incidents(str(tmp_path))) == 1
+
+
+# ---------------------------------------------------------------------------
+# door span <-> histogram reconciliation
+# ---------------------------------------------------------------------------
+
+def test_door_span_sums_reconcile_with_latency_histogram(tmp_path):
+    """The PR-13 discipline at pool scope: every door trace's phase
+    segments tile [submit, delivery] exactly, so the spans summed over
+    ALL requests equal the `frontdoor/latency_ms` histogram total."""
+    tel = Telemetry.create(str(tmp_path))
+    (r0, _), (r1, _) = (_replica("r0", tel, delay=0.02),
+                        _replica("r1", tel, delay=0.02))
+    door = _door([r0, r1], tel)
+    futs = [door.submit(SampleRequest(resolution=8, diffusion_steps=4,
+                                      sampler="ddim", seed=40 + i))
+            for i in range(4)]
+    for f in futs:
+        f.result(timeout=30)
+    door.close()
+    tel.close()
+    rows = [json.loads(line) for line in
+            (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+    door_rows = [r for r in rows if r.get("type") == "request_trace"
+                 and r.get("hop") == "door"]
+    assert len(door_rows) == 4
+    tiled_total = 0.0
+    for t in door_rows:
+        tiled = sum(ms for name, ms in t["phase_ms"].items()
+                    if name != "door.hedge")
+        assert tiled == pytest.approx(t["latency_ms"], abs=1e-6)
+        tiled_total += tiled
+    hist = tel.registry.histogram("frontdoor/latency_ms").snapshot()
+    assert hist["count"] == 4
+    assert tiled_total == pytest.approx(hist["sum"], abs=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# byte-stable per-tenant SLO artifact (serving/loadgen.py)
+# ---------------------------------------------------------------------------
+
+def test_tenant_slo_artifact_byte_stable_and_key_set_pinned(tmp_path):
+    from flaxdiff_tpu.serving.loadgen import (TENANT_SLO_FILENAME,
+                                              TENANT_SLO_SCHEMA_VERSION,
+                                              write_tenant_slo)
+    report = {"tenants": {
+        "b": {"requests": 4, "completed": 4, "shed": 0, "faulted": 0,
+              "errors": 0, "slo_ms": 250.0, "slo_attainment": 0.75,
+              "latency_ms": {"p50": 10.123456, "p99": 20.98765}},
+        "a": {"requests": 2, "completed": 1, "shed": 1, "faulted": 0,
+              "errors": 0, "slo_ms": None, "slo_attainment": 0.5,
+              "latency_ms": {"p50": 1.0, "p99": 2.0}},
+    }}
+    p1 = write_tenant_slo(report, str(tmp_path / "one"))
+    p2 = write_tenant_slo(report, str(tmp_path / "two"))
+    assert p1.endswith(TENANT_SLO_FILENAME)
+    with open(p1, "rb") as f1, open(p2, "rb") as f2:
+        b1, b2 = f1.read(), f2.read()
+    assert b1 == b2 and b1.endswith(b"\n")      # the contract: bytes
+    doc = json.loads(b1)
+    assert doc["schema_version"] == TENANT_SLO_SCHEMA_VERSION == 1
+    assert list(doc["tenants"]) == ["a", "b"]   # sorted tenants
+    assert set(doc["tenants"]["a"]) == {
+        "requests", "completed", "shed", "faulted", "errors", "slo_ms",
+        "attainment", "p50_ms", "p99_ms"}
+    assert doc["tenants"]["b"]["attainment"] == 0.75
+    assert doc["tenants"]["b"]["p50_ms"] == 10.123
+
+
+def test_run_open_loop_writes_artifact_and_feeds_door_slo(tmp_path):
+    """The harness tags each tenant's requests, the door's SLO engine
+    sees them per tenant, and `artifact_dir` lands the byte-stable
+    summary next to the run."""
+    from flaxdiff_tpu.serving import (OpenLoopSpec, TenantSpec,
+                                      run_open_loop)
+    tel = Telemetry(enabled=False)
+    (r0, _), = (_replica("r0", tel),)
+    door = _door([r0], tel)
+    spec = OpenLoopSpec(tenants=(
+        TenantSpec(name="t0", n_requests=4, rate_hz=200.0,
+                   shape="poisson",
+                   mix=({"resolution": 8, "diffusion_steps": 4,
+                         "sampler": "ddim"},)),), seed=5)
+    rep = run_open_loop(door, spec, workers=2, timeout_s=60,
+                        artifact_dir=str(tmp_path))
+    door.close()
+    assert rep["completed"] == 4
+    with open(tmp_path / "tenant_slo.json", "r",
+              encoding="utf-8") as f:
+        doc = json.load(f)
+    assert doc["tenants"]["t0"]["completed"] == 4
+    assert doc["tenants"]["t0"]["attainment"] == 1.0
+    # tenant attribution reached the ONLINE engine through the door
+    assert "slo/attainment/t0" in tel.registry.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# compare_runs: attainment drops + new incidents are regressions
+# ---------------------------------------------------------------------------
+
+def _evidence_dir(tmp_path, name, attainment, incident=False):
+    d = tmp_path / name
+    d.mkdir()
+    (d / "tenant_slo.json").write_text(json.dumps(
+        {"schema_version": 1, "tenants": {
+            "t0": {"requests": 8, "completed": 8, "shed": 0,
+                   "faulted": 0, "errors": 0, "slo_ms": 250.0,
+                   "attainment": attainment, "p50_ms": 10.0,
+                   "p99_ms": 20.0}}}))
+    if incident:
+        (d / "incident-001-replica_lost.json").write_text(json.dumps(
+            {"schema_version": 1, "kind": "replica_lost"}))
+    return str(d)
+
+
+def test_compare_runs_flags_attainment_drop_and_new_incidents(
+        tmp_path, capsys):
+    from scripts.compare_runs import main
+    a = _evidence_dir(tmp_path, "a", 1.0)
+    b = _evidence_dir(tmp_path, "b", 0.5, incident=True)
+    assert main([a, b, "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    regs = {(r["stage"], r["metric"]) for r in doc["regressions"]}
+    assert ("tenant_slo", "t0/attainment") in regs   # down = worse
+    # a bundle appearing from a ZERO base is a regression — count
+    # semantics, not relative thresholds
+    assert ("incidents", "incidents/total") in regs
+    # the reverse direction is an improvement, not a regression
+    assert main([b, a, "--json"]) == 0
+    capsys.readouterr()
+    # text mode names the finding
+    assert main([a, b]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "attainment" in out
+
+
+# ---------------------------------------------------------------------------
+# diagnose_run: schema_version pin + SLO / Incidents sections
+# ---------------------------------------------------------------------------
+
+def test_diagnose_json_schema_pinned_and_incident_sections(
+        tmp_path, capsys):
+    """Regression pin (ISSUE 18): the --json report carries
+    `schema_version` and EXACTLY this top-level key set — consumers
+    parse it blind, so a key appearing or vanishing is a contract
+    change, not a refactor."""
+    from scripts.diagnose_run import REPORT_SCHEMA_VERSION, main
+    log = EventLog("diagnose-test")
+    with use_event_log(log):
+        tel = Telemetry.create(str(tmp_path))
+        tel.write_record({"type": "request_trace",
+                          "trace_id": "door-1-0", "outcome": "ok",
+                          "queue_ms": 1.0, "compile_ms": 2.0,
+                          "device_ms": 3.0, "latency_ms": 6.0,
+                          "sampler": "ddim", "nfe": 4,
+                          "resolution": 8})
+        tel.registry.gauge("slo/attainment/t0").set(0.5)
+        tel.registry.gauge("slo/burn_fast/t0").set(5.0)
+        tel.registry.gauge("slo/burn_slow/t0").set(2.0)
+        tel.export(step=1)
+        tel.flightrec.incident("replica_lost", "test kill r0", step=3)
+        tel.close()
+
+    assert main([str(tmp_path), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 1
+    assert set(doc) == {"schema_version", "goodput", "steps",
+                        "phase_rows", "step_wall_s", "pod_last",
+                        "health", "elasticity", "frontdoor", "slo",
+                        "incidents", "data_health", "request_traces",
+                        "programs"}
+    assert doc["slo"]["slo/attainment/t0"] == 0.5
+    assert len(doc["incidents"]) == 1
+    inc = doc["incidents"][0]
+    assert inc["kind"] == "replica_lost" and inc["step"] == 3
+    assert inc["records"] >= 1 and inc["trace_ids"] >= 1
+
+    assert main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "== SLO budgets" in out and "<- BURNING" in out
+    assert "== Incidents (1 bundle(s)) ==" in out
+    assert "001-replica_lost" in out and "test kill r0" in out

@@ -182,6 +182,13 @@ def test_repo_lint_clean_unified(capsys):
     assert not any(f["rule"] in ("partition-coverage",
                                  "implicit-reshard")
                    for f in data["findings"])
+    # ISSUE 18: the SLO engine and flight recorder are host
+    # bookkeeping by contract — their host-sync budgets are pinned at
+    # ZERO and the clean run above proves they hold
+    from flaxdiff_tpu.analysis.budgets import ALLOWLIST
+    for pinned in ("flaxdiff_tpu/telemetry/slo.py",
+                   "flaxdiff_tpu/telemetry/flightrec.py"):
+        assert ALLOWLIST["host-sync"][pinned] == 0, pinned
 
 
 def test_lint_json_output_is_stable(capsys):
