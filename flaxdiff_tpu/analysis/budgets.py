@@ -29,6 +29,12 @@ ALLOWLIST: Dict[str, Dict[str, int]] = {
         # either would silently tax every request they observe
         "flaxdiff_tpu/telemetry/slo.py": 0,
         "flaxdiff_tpu/telemetry/flightrec.py": 0,
+        # device profiling is window bookkeeping + capture parsing by
+        # contract: explicit ZERO pin (ISSUE 19) — the pipeline drain a
+        # window close needs happens in the TRAINER through its counted
+        # seam; a sync inside devprof.py would tax every step the
+        # profiler merely watches
+        "flaxdiff_tpu/telemetry/devprof.py": 0,
         "flaxdiff_tpu/serving/loadgen.py": 2,
         "flaxdiff_tpu/trainer/autoencoder_trainer.py": 4,
         "flaxdiff_tpu/trainer/logging.py": 2,

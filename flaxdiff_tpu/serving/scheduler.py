@@ -144,7 +144,7 @@ class ServingScheduler:
     def __init__(self, pipeline=None, engine=None,
                  config: Optional[SchedulerConfig] = None,
                  telemetry=None, autostart: bool = True,
-                 engine_factory=None):
+                 engine_factory=None, profiler=None):
         if telemetry is None:
             from ..telemetry import global_telemetry
             telemetry = global_telemetry()
@@ -169,6 +169,13 @@ class ServingScheduler:
         # performs the IDENTICAL seam-counted host syncs as an untraced
         # one (counting-mock tested) — tracing is host bookkeeping only
         self.tracer = RequestTracer(telemetry)
+        # device-profile hook (telemetry/devprof.py DeviceProfiler):
+        # polled once per dispatch round with the round number — host
+        # bookkeeping only (window open/close + capture parse), never
+        # touches the program cache, so an armed profiler keeps warm
+        # replays retrace-free (counting-mock + re_traces tested).
+        # None (the default) costs one attribute check per round.
+        self.profiler = profiler
         self.supervisor = EngineSupervisor(telemetry)
         self.brownout = (BrownoutPolicy(self.config.brownout, telemetry)
                          if self.config.brownout is not None else None)
@@ -726,6 +733,11 @@ class ServingScheduler:
                 self._round_no += 1
                 self._last_served[gk] = self._round_no
 
+            if self.profiler is not None:
+                # outside the lock: the poll may parse a closing
+                # window's capture (host-only work that must not stall
+                # admission)
+                self.profiler.poll_round(self._round_no)
             bucket = bucket_up(len(rows), buckets)
             round_steps = cfg.round_steps or nfe_bucket(
                 max(r.remaining for r in rows))

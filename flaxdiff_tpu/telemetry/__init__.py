@@ -56,7 +56,15 @@ the ROADMAP depends on — you cannot speed up what you cannot attribute:
               provenance helpers that name the module that blew up
   memory      MemoryMonitor: HBM gauges from device.memory_stats()
               (bytes-in-use, peak, per-step watermark, utilization),
-              self-disabling on backends without allocator stats
+              falling back to host-RSS gauges (/proc/self/statm) on
+              backends without allocator stats
+  devprof     DeviceProfiler + trace attribution parser: automated
+              jax.profiler windows (step/round cadence, trigger file)
+              parsed into byte-stable devprof.jsonl rows — device ms
+              by op family and model module, collective-vs-compute
+              split, layout-copy/fusion-gap counters — reconciled
+              against the program registry (measured MFU, roofline
+              verdict, predicted-vs-measured comm calibration)
   hub         Telemetry: the bundle the other layers talk to, plus the
               process-global default (`global_telemetry`) for layers
               with no plumbing
@@ -73,6 +81,13 @@ from .aggregate import (
     DISABLED_SENTINEL,
     AggregationDisabled,
     CrossHostAggregator,
+)
+from .devprof import (
+    DEVPROF_FILENAME,
+    DeviceProfiler,
+    read_devprof,
+    reconcile,
+    summarize_events,
 )
 from .goodput import GOODPUT_FILENAME, GoodputLedger
 from .hub import (
@@ -165,6 +180,11 @@ __all__ = [
     "Anomaly",
     "ANOMALY_ACTIONS",
     "MemoryMonitor",
+    "DeviceProfiler",
+    "DEVPROF_FILENAME",
+    "read_devprof",
+    "reconcile",
+    "summarize_events",
     "ProgramRegistry",
     "PROGRAMS_FILENAME",
     "hardware_fingerprint",

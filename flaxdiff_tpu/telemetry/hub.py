@@ -32,6 +32,7 @@ import threading
 from typing import Dict, List, Optional
 
 from .aggregate import CrossHostAggregator
+from .devprof import DEVPROF_FILENAME
 from .flightrec import FlightRecorder
 from .goodput import GOODPUT_FILENAME, GoodputLedger
 from .metrics import (JsonlExporter, LoggerExporter, MetricsRegistry,
@@ -54,7 +55,8 @@ class Telemetry:
                  enabled: Optional[bool] = None,
                  epoch: Optional[int] = None,
                  programs: Optional[ProgramRegistry] = None,
-                 flightrec: Optional["FlightRecorder"] = None):
+                 flightrec: Optional["FlightRecorder"] = None,
+                 devprof_path: Optional[str] = None):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.exporters = list(exporters)
         self.recorder = recorder
@@ -75,6 +77,11 @@ class Telemetry:
         # the disabled hub — compile sites check for it and skip
         # registration entirely, so the default path sees zero change
         self.programs = programs
+        # device-profile evidence sink (telemetry/devprof.py): the
+        # trainer/scheduler build a DeviceProfiler against this path
+        # when profile windows are configured; None (the disabled hub)
+        # keeps the profiler unbuilt — zero change off-telemetry
+        self.devprof_path = devprof_path
         # every raw JSONL row is stamped with this epoch (the
         # pod-agreed job incarnation — see set_epoch); defaults to the
         # local goodput incarnation so even a solo host's rows are
@@ -135,6 +142,7 @@ class Telemetry:
             programs=ProgramRegistry(_in_dir(PROGRAMS_FILENAME),
                                      registry=registry),
             flightrec=flightrec,
+            devprof_path=_in_dir(DEVPROF_FILENAME),
             enabled=True)
 
     # -- instruments ---------------------------------------------------------

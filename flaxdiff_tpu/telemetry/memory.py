@@ -1,5 +1,5 @@
-"""HBM memory gauges: `device.memory_stats()` sampled into the metrics
-registry.
+"""Memory gauges: `device.memory_stats()` sampled into the metrics
+registry, with a host-RSS fallback where no device reports stats.
 
 An OOM on a pod is the one failure the resilience layer cannot recover
 (the process dies inside XLA); the only defense is seeing the watermark
@@ -21,26 +21,46 @@ bounded-cardinality series:
     memory/devices               local devices reporting stats
 
 Backends without `memory_stats()` (CPU returns None; some plugins
-raise) disable the monitor after the first empty sample — later calls
-are a single attribute read, so leaving the monitor wired in the
-trainer costs nothing off-TPU.
+raise) fall back to HOST process memory — `/proc/self/statm` times the
+page size, no `resource`/`psutil` dependency — so memory pressure is
+observable everywhere, not only on TPU:
+
+    memory/host_rss_bytes        resident set size of this process
+    memory/host_rss_peak_bytes   max RSS seen by this monitor
+    memory/host_vms_bytes        virtual size of this process
+
+The two key sets are disjoint on purpose: consumers that probe
+`memory/peak_bytes_in_use` (the program registry's HBM field) read
+None in host mode instead of a host number masquerading as HBM. On
+platforms without `/proc` the monitor latches disabled after the first
+empty sample — later calls are a single attribute read, so leaving the
+monitor wired in the trainer costs nothing anywhere.
 """
 from __future__ import annotations
 
 import logging
+import os
 from typing import Dict, List, Optional
 
 log = logging.getLogger("flaxdiff_tpu.telemetry")
 
+_STATM_PATH = "/proc/self/statm"
+
 
 class MemoryMonitor:
-    """Bounded-cardinality HBM gauge sampler (host-side, no device
-    work — allocator stats are a local C++ call)."""
+    """Bounded-cardinality memory gauge sampler (host-side, no device
+    work — allocator stats are a local C++ call, the host fallback one
+    procfs read)."""
 
-    def __init__(self, devices: Optional[List] = None):
+    def __init__(self, devices: Optional[List] = None,
+                 statm_path: str = _STATM_PATH):
         self._devices = devices
         self.disabled = False
         self._watermark = 0.0
+        self._statm_path = statm_path
+        self._page: Optional[float] = None
+        self._host_mode = False      # latched on the first empty probe
+        self._host_peak = 0.0
 
     def _device_stats(self) -> List[Dict[str, float]]:
         if self._devices is None:
@@ -52,24 +72,52 @@ class MemoryMonitor:
                 stats = d.memory_stats()
             except Exception as e:  # noqa: BLE001 — plugin backends may
                 # raise instead of returning None; one debug line, then
-                # the disabled latch makes this a no-op forever
+                # the host-mode latch makes the probe a no-op forever
                 log.debug("memory_stats() failed on %r: %s", d, e)
                 continue
             if stats:
                 out.append(stats)
         return out
 
+    def _host_sample(self) -> Dict[str, float]:
+        """Process RSS/VMS from `/proc/self/statm` (pages -> bytes via
+        the system page size; resource/psutil-free). `{}` + the
+        disabled latch where procfs is unavailable."""
+        try:
+            with open(self._statm_path, "r", encoding="ascii") as f:
+                parts = f.read().split()
+            if self._page is None:
+                self._page = float(os.sysconf("SC_PAGE_SIZE"))
+            vms = float(parts[0]) * self._page
+            rss = float(parts[1]) * self._page
+        except (OSError, IndexError, ValueError):
+            self.disabled = True
+            log.debug("no device memory_stats() and no readable %s; "
+                      "memory gauges disabled for this process",
+                      self._statm_path)
+            return {}
+        self._host_peak = max(self._host_peak, rss)
+        return {
+            "memory/host_rss_bytes": rss,
+            "memory/host_rss_peak_bytes": self._host_peak,
+            "memory/host_vms_bytes": vms,
+        }
+
     def sample(self) -> Dict[str, float]:
-        """One flat gauge snapshot; `{}` on backends without stats
-        (after which the monitor latches disabled)."""
+        """One flat gauge snapshot: HBM series when any device reports
+        allocator stats, host-RSS series otherwise; `{}` only where
+        neither source exists (after which the monitor latches
+        disabled)."""
         if self.disabled:
             return {}
+        if self._host_mode:
+            return self._host_sample()
         per = self._device_stats()
         if not per:
-            self.disabled = True
-            log.debug("no device reports memory_stats(); "
-                      "HBM gauges disabled for this process")
-            return {}
+            self._host_mode = True
+            log.debug("no device reports memory_stats(); falling back "
+                      "to host RSS gauges (memory/host_*)")
+            return self._host_sample()
         in_use = max(float(s.get("bytes_in_use", 0.0)) for s in per)
         peak = max(float(s.get("peak_bytes_in_use", 0.0)) for s in per)
         limits = [float(s["bytes_limit"]) for s in per if "bytes_limit" in s]

@@ -99,8 +99,16 @@ def stable_json(row: Dict[str, Any]) -> str:
 
 
 def read_registry(path: str) -> List[Dict[str, Any]]:
-    """Rows of a `programs.jsonl` file (torn tail tolerated)."""
+    """Rows of a `programs.jsonl` file (torn tail tolerated).
+
+    `program_update` rows — the append-only write-back channel
+    `ProgramRegistry.annotate` uses for measured devprof fields — are
+    MERGED into their `program` row (matched on kind+key) instead of
+    returned, so readers see one row per program with measured fields
+    in place and the file itself stays append-only/byte-stable. An
+    orphan update (its program row lost to a torn tail) is dropped."""
     rows: List[Dict[str, Any]] = []
+    index: Dict[Tuple[str, str], Dict[str, Any]] = {}
     if not os.path.exists(path):
         return rows
     with open(path, "r", encoding="utf-8") as f:
@@ -112,8 +120,17 @@ def read_registry(path: str) -> List[Dict[str, Any]]:
                 rec = json.loads(line)
             except json.JSONDecodeError:
                 continue        # torn tail from a crash
-            if isinstance(rec, dict):
-                rows.append(rec)
+            if not isinstance(rec, dict):
+                continue
+            if rec.get("type") == "program_update":
+                tgt = index.get((rec.get("kind"), rec.get("key")))
+                if tgt is not None:
+                    tgt.update({k: v for k, v in rec.items()
+                                if k not in ("type", "kind", "key")})
+                continue
+            if rec.get("type") == "program":
+                index[(rec.get("kind"), rec.get("key"))] = rec
+            rows.append(rec)
     return rows
 
 
@@ -182,6 +199,31 @@ class ProgramRegistry:
                     f.write(stable_json(row) + "\n")
         if self._metrics is not None:
             self._metrics.counter("telemetry/programs_registered").inc()
+        return row
+
+    def annotate(self, kind: str, key: Any,
+                 fields: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Write measured fields back onto an already-registered
+        program (the devprof reconciliation channel): the in-memory
+        row is updated and an append-only `program_update` row lands
+        in the file — the base row's bytes never change, and
+        `read_registry` merges the update on read. Returns the merged
+        row, or None when (kind, key) was never registered (nothing to
+        annotate — the measured window had no registered program)."""
+        ident = (str(kind), str(key))
+        clean = {k: v for k, v in fields.items()
+                 if k not in ("type", "kind", "key")}
+        with self._lock:
+            row = self._rows.get(ident)
+            if row is None:
+                return None
+            row.update(clean)
+            if self.path:
+                rec: Dict[str, Any] = {"type": "program_update",
+                                       "kind": ident[0], "key": ident[1]}
+                rec.update(clean)
+                with open(self.path, "a", encoding="utf-8") as f:
+                    f.write(stable_json(rec) + "\n")
         return row
 
     def record_jitted(self, kind: str, key: Any, jitted, args: tuple,

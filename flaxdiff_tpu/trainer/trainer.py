@@ -114,6 +114,21 @@ class TrainerConfig:
     profile_dir: Optional[str] = None
     profile_at_step: int = 10
     profile_steps: int = 5
+    # Automated device-profile windows (telemetry/devprof.py): > 0
+    # opens a jax.profiler window of `profile_steps` steps every
+    # `profile_cadence` steps under an ENABLED telemetry hub, parses
+    # the capture into a `devprof.jsonl` attribution row (op families,
+    # modules, collective split) reconciled against the program
+    # registry (measured MFU, roofline verdict, comm calibration).
+    # Window overhead lands in the `profile` phase + goodput bucket;
+    # off-window steps pay two int compares — no device work, no host
+    # syncs. Independent of the one-shot profile_dir capture above.
+    profile_cadence: int = 0
+    # On-demand arming: when this path exists at a log step, it is
+    # consumed and ONE profile window opens at the next step — the
+    # "profile the live run NOW" knob (also reachable while
+    # profile_cadence is 0).
+    profile_trigger: Optional[str] = None
     # Heartbeat watchdog (resilience/watchdog.py): None disables. When a
     # step (or the loader feeding it) stalls past this many seconds, a
     # `watchdog_stall` event is recorded and the stall action runs:
@@ -510,7 +525,7 @@ class DiffusionTrainer:
     def _register_program_evidence(self, tel, global_batch,
                                    registered: set,
                                    compile_s, monitored_compiled: bool,
-                                   flops_cost) -> None:
+                                   flops_cost) -> Optional[str]:
         """Program evidence registry hook (telemetry/programs.py): one
         `programs.jsonl` row per compiled step program — the plain step
         at the first log window, the monitored twin once it has
@@ -521,7 +536,7 @@ class DiffusionTrainer:
         is the documented compile blowup)."""
         reg = getattr(tel, "programs", None)
         if reg is None:
-            return
+            return None
         from ..parallel.context import use_mesh
         from ..profiling import jaxpr_flops
         batch = self._numeric_subtree(global_batch)
@@ -566,6 +581,9 @@ class DiffusionTrainer:
                 collectives=collectives,
                 comm_bytes_by_axis=comm_by_axis,
                 extra={"compile_source": "first_step_busy"})
+        # the plain step's registry identity — the devprof window-close
+        # path reconciles its measured row against exactly this key
+        return f"train_step:{sig}"
 
     # -- checkpointing -------------------------------------------------------
     def save_checkpoint(self, force: bool = False) -> bool:
@@ -846,6 +864,21 @@ class DiffusionTrainer:
                           action=cfg.anomaly_action),
             telemetry=tel)
         memory = MemoryMonitor()
+        # Automated device-profile windows (telemetry/devprof.py):
+        # built only when configured AND the hub is enabled with a
+        # devprof sink — the default path carries no profiler object
+        # at all, so un-configured fits see zero change.
+        devprof = None
+        if timed and getattr(tel, "devprof_path", None) and (
+                cfg.profile_cadence > 0
+                or cfg.profile_trigger is not None):
+            from ..telemetry.devprof import DeviceProfiler
+            devprof = DeviceProfiler(
+                tel.devprof_path,
+                cadence=cfg.profile_cadence,
+                window=max(cfg.profile_steps, 1),
+                trigger_path=cfg.profile_trigger,
+                metrics=tel.registry)
         history["anomalies"] = 0
         last_health = {"grad_norm": None}   # latest cadence grad norm
         provenance_done = False     # the debug re-run happens ONCE per fit
@@ -1286,6 +1319,10 @@ class DiffusionTrainer:
                     steady_busies.append(busy)
             goodput.record_badput("data_stall", phases.get("data_wait", 0.0))
             goodput.record_badput("numerics", phases.get("numerics", 0.0))
+            # device-profile window overhead (open/close + the close's
+            # pipeline drain + capture parse) is measurement, not
+            # training — its own bucket keeps the MFU account honest
+            goodput.record_badput("profile", phases.get("profile", 0.0))
             # elastic transitions that ran inside this step's checkpoint
             # phase were already attributed to their own bucket
             # (elastic_shrink/elastic_readmit) — subtract them so each
@@ -1375,6 +1412,34 @@ class DiffusionTrainer:
                     # these steps close dispatch anyway (twin compile /
                     # window fetch): take the free exact device sample
                     timer.mark_sampled()
+                if devprof is not None:
+                    # automated profile windows: open BEFORE this
+                    # step's dispatch, close before the first dispatch
+                    # PAST the window — both inside the `profile`
+                    # phase, which settle_step books to its own badput
+                    # bucket so window overhead never pollutes MFU.
+                    # The close drains the pipeline through the counted
+                    # sync seam (every step dispatched inside the
+                    # window lands in the capture) and reconciles the
+                    # parsed row against the step's registry program;
+                    # off-window steps reach neither branch — two int
+                    # compares, zero syncs.
+                    if devprof.should_close(i + 1):
+                        with timer.phase("profile"):
+                            if pending_loss is not None:
+                                _block_until_ready(pending_loss)
+                            inflight.clear()
+                            prog_key = self._register_program_evidence(
+                                tel, current, registered_programs,
+                                (compile_busies[0] if compile_busies
+                                 else None),
+                                monitored_compiled, flops)
+                            devprof.close(i + 1, kind="train_step",
+                                          key=prog_key,
+                                          programs=tel.programs)
+                    elif devprof.should_open(i + 1):
+                        with timer.phase("profile"):
+                            devprof.open(i + 1)
                 if watchdog is not None and (i == 0 or compile_step):
                     # first call of either program pays jit compile —
                     # not a stall
@@ -1680,6 +1745,11 @@ class DiffusionTrainer:
                                 commit_save()
                             goodput.persist()
                 settle_step(i, compile_step=compile_step)
+                if devprof is not None and log_step:
+                    # on-demand arming rides the log cadence (one host
+                    # stat per window, zero cost on other steps): an
+                    # existing trigger file opens a window next step
+                    devprof.poll_trigger()
 
             # The final save can legitimately outlast the watchdog timeout
             # (sync flush of an async save) — stand the watchdog down
@@ -1716,6 +1786,16 @@ class DiffusionTrainer:
                 if pending_loss is not None:
                     _block_until_ready(pending_loss)
                 profile_ctx.__exit__(None, None, None)
+            if devprof is not None and devprof.active():
+                # a cadence window still open past the last step:
+                # drain, close and parse it here so the capture still
+                # becomes a devprof row — attributed to the same
+                # `profile` bucket as in-loop closes
+                with goodput.measure_badput("profile"):
+                    if pending_loss is not None:
+                        _block_until_ready(pending_loss)
+                    devprof.close(kind="train_step",
+                                  programs=tel.programs)
             if handler_installed:
                 signal.signal(signal.SIGTERM,
                               prev_handler if prev_handler is not None

@@ -1,11 +1,16 @@
 #!/usr/bin/env python
 """Summarize a jax.profiler trace: device time by op family.
 
-Parses the Chrome-trace JSON (`.trace.json.gz`) a `bench.py --trace` or
-`--profile_dir` capture writes, and prints per-op-family device time so
-a step's budget is attributable at a glance — the analysis that drove
-the r3 kernel tuning (attention 35% of step, ~750 layout copies)
-without needing TensorBoard.
+THIN SHIM: the parsing/attribution logic this script pioneered (the
+r3 analysis — attention 35% of step, ~750 layout copies) now lives in
+`flaxdiff_tpu/telemetry/devprof.py`, where the trainer's automated
+profile windows use it to write `devprof.jsonl` evidence rows. This
+CLI keeps the old flags and output format for hand-run captures, and
+delegates every parsing decision to the library — plus two fixes the
+old script silently lacked: truncated/corrupt captures are REPORTED
+(`skipped_corrupt: ...`), and a capture with only host-side XLA events
+(the CPU backend) is summarized with an explicit `host_xla` note
+instead of being conflated with "no data".
 
 Usage:
     python scripts/analyze_trace.py bench_trace
@@ -20,57 +25,27 @@ from __future__ import annotations
 
 import argparse
 import collections
-import glob
-import gzip
-import json
-import os
-import re
 import sys
 
+from flaxdiff_tpu.telemetry import devprof as _devprof
 
-def find_trace(path: str):
-    """(path, parsed events or None): newest capture that actually has a
-    device timeline — a wedged tunnel or CPU fallback leaves host-only
-    captures behind, and the newest file is not necessarily the useful
-    one. Events are returned parsed so main() does not re-load a
-    hundreds-of-MB JSON a second time."""
-    if os.path.isfile(path):
-        return path, None
-    hits = sorted(glob.glob(
-        os.path.join(path, "**", "*.trace.json.gz"), recursive=True))
-    if not hits:
-        raise SystemExit(f"no *.trace.json.gz under {path!r}")
-    for hit in reversed(hits):
-        try:
-            events = load_events(hit)
-            if device_pids(events):
-                return hit, events
-        except (OSError, EOFError, ValueError, KeyError):
-            continue   # truncated/corrupt capture (killed run): skip
-    return hits[-1], None   # none has device events; report on the newest
-
-
-def load_events(path: str):
-    opener = gzip.open if path.endswith(".gz") else open
-    with opener(path) as f:
-        return json.load(f)["traceEvents"]
-
-
-def device_pids(events) -> dict:
-    pids = {}
-    for e in events:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
-            name = e["args"].get("name", "")
-            if "device:" in name.lower() and "cpu" not in name.lower():
-                pids[e["pid"]] = name
-    return pids
+# re-exported for importers of the old module API
+load_events = _devprof.load_events
+device_pids = _devprof.device_pids
 
 
 def family(name: str) -> str:
-    """Strip the SSA counter: 'attn1.27' -> 'attn', 'fusion.4597' ->
-    'fusion'."""
-    fam = re.split(r"[.\d]", name)[0]
-    return fam or name
+    """Strip the SSA counter: 'attn1.27' -> 'attn' (delegates to
+    devprof.op_family)."""
+    return _devprof.op_family(name)
+
+
+def find_trace(path: str):
+    """(path, parsed events or None): newest capture that actually has
+    an attributable timeline — legacy signature kept for importers;
+    corrupt captures are skipped here and REPORTED by main()."""
+    hit, events, _skipped = _devprof.find_capture(path)
+    return hit, events
 
 
 def main(argv=None):
@@ -84,25 +59,25 @@ def main(argv=None):
                     help="per-op rows instead of family aggregates")
     args = ap.parse_args(argv)
 
-    path, events = find_trace(args.trace)
+    path, events, skipped = _devprof.find_capture(args.trace)
+    for p in skipped:
+        print(f"skipped_corrupt: {p} (truncated/unreadable capture)")
     if events is None:
         events = load_events(path)
-    pids = device_pids(events)
-    if not pids:
+    source, ops = _devprof.select_op_events(events)
+    if source == "host_only":
         raise SystemExit(
-            f"{path}: no device timeline (host-only capture — the trace "
+            f"{path}: no device timeline (host_only capture — the trace "
             "window probably closed before any device work ran)")
+    if source == "host_xla":
+        print("host_xla: no device timeline; attributing host-side XLA "
+              "op events (CPU backend capture)")
 
     agg = collections.Counter()
     cnt = collections.Counter()
     total = 0
-    for e in events:
-        if e.get("ph") != "X" or e.get("pid") not in pids:
-            continue
+    for e in ops:
         name = e.get("name", "?")
-        # skip the enclosing module/step envelopes so leaf ops sum ~total
-        if name.startswith("jit_") or name.isdigit():
-            continue
         key = name if args.raw else family(name)
         dur = e.get("dur", 0)
         agg[key] += dur
@@ -110,7 +85,9 @@ def main(argv=None):
         total += dur
 
     print(f"{path}")
-    print(f"devices: {', '.join(pids.values())}")
+    pids = device_pids(events)
+    if pids:
+        print(f"devices: {', '.join(pids.values())}")
     print(f"device op time: {total / 1e3 / args.steps:.2f} ms"
           + ("/step" if args.steps > 1 else ""))
     print(f"{'op family' if not args.raw else 'op':42} "

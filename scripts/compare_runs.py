@@ -22,7 +22,10 @@ Inputs (auto-detected per argument):
   recorder `incident-*.json` bundles diff as per-kind counts in an
   `incidents` stage where ANY increase is a regression (counts, not
   percentages — one new replica_lost incident is a finding even from a
-  zero base).
+  zero base). Device-profile windows (`devprof.jsonl`, ISSUE 19) diff
+  as a `devprof` stage from the last parsed window: per-op-family ms
+  UP is worse, measured MFU / achieved comm bandwidth DOWN is worse,
+  op counts and predicted comm bytes are neutral program-shape facts.
 - a **bench result file** (the final JSON line of `bench.py`, e.g.
   `BENCH_r05.json`): compares numeric leaves per stage.
 
@@ -72,7 +75,8 @@ _UP_IS_WORSE = ("_ms", "latency", "_s", "p50", "p99", "max", "mean",
                 "burn")
 _DOWN_IS_WORSE = ("speedup", "throughput", "imgs_per_sec", "mfu",
                   "hit_rate", "fraction", "psnr", "occupancy",
-                  "samples_per_s", "goodput", "rps", "attainment")
+                  "samples_per_s", "goodput", "rps", "attainment",
+                  "achieved")
 # pure identity/config numbers: never a finding in either direction
 # (flops is here too: a FLOPs change means the PROGRAM changed shape —
 # report it, but it is a different experiment, not a regression)
@@ -221,6 +225,45 @@ def load_telemetry_dir(path: str) -> Dict[str, Any]:
     # (stage rows are compared by bare key, without the stage name)
     stages["incidents"] = {f"incidents/{k}": v
                            for k, v in counts.items()}
+    # device-profile windows (devprof.jsonl, ISSUE 19): the LAST
+    # successfully parsed window is the current device-time
+    # attribution. Per-op-family ms regress UP ("attn got slower"),
+    # measured MFU and achieved comm bandwidth regress DOWN, op counts
+    # and predicted comm bytes are program-shape facts (neutral).
+    dev_path = os.path.join(path, "devprof.jsonl")
+    dev_rows = [r for r in (read_jsonl(dev_path)
+                            if os.path.exists(dev_path) else [])
+                if r.get("type") == "devprof"]
+    ok_rows = [r for r in dev_rows if r.get("status") == "ok"]
+    if ok_rows:
+        last = ok_rows[-1]
+        dp: Dict[str, Any] = {
+            "windows": float(len(dev_rows)),
+            "device_ms_per_step": last.get("device_ms_per_step"),
+            "collective_ms": last.get("collective_ms"),
+            "collective_count": last.get("collective_count"),
+            "compute_ms": last.get("compute_ms"),
+            "layout_copy_ms": last.get("layout_copy_ms"),
+            "layout_copy_count": last.get("layout_copy_count"),
+            "fusion_gap_ms": last.get("fusion_gap_ms"),
+            "fusion_gap_count": last.get("fusion_gap_count"),
+            "measured_mfu": last.get("measured_mfu"),
+            "measured_flops_per_s": last.get("measured_flops_per_s"),
+            "comm_measured_ms": last.get("comm_measured_ms"),
+            # neutral via the comm_bytes path rule: predicted bytes
+            # describe the PROGRAM, not the run
+            "comm_bytes_predicted": last.get("comm_predicted_bytes"),
+            "comm_achieved_bytes_per_s":
+                last.get("comm_achieved_bytes_per_s"),
+        }
+        for fam, f in sorted((last.get("families") or {}).items()):
+            if isinstance(f, dict):
+                dp[f"families/{fam}_ms"] = f.get("ms")
+                dp[f"families/{fam}_count"] = f.get("count")
+        stages["devprof"] = {f"devprof/{k}": float(v)
+                             for k, v in dp.items()
+                             if isinstance(v, (int, float))
+                             and not isinstance(v, bool)}
     fp: Dict[str, Any] = {}
     programs: Dict[str, Dict[str, float]] = {}
     from flaxdiff_tpu.telemetry.programs import (PROGRAMS_FILENAME,

@@ -9,7 +9,12 @@ Ingests what the telemetry subsystem wrote during a run
                       `request_trace` rows
     goodput.json      the cumulative productive/badput account
     programs.jsonl    the program evidence registry (compile ms, FLOPs
-                      per compiled program)
+                      per compiled program), `program_update` rows
+                      merged in (measured MFU / roofline annotations
+                      written back by the device profiler)
+    devprof.jsonl     device-profile windows (telemetry/devprof.py):
+                      device ms by op family and module, collective
+                      vs. compute split, reconciliation verdicts
     trace.json        Chrome trace-event spans (validated, not rendered
                       — load it in https://ui.perfetto.dev; bounded-
                       event drops are reported here and counted at
@@ -34,7 +39,10 @@ from typing import Dict, List, Optional
 
 # --json output contract: bump when top-level keys change shape or
 # meaning (tests pin the key set against this version)
-REPORT_SCHEMA_VERSION = 1
+# v2: + device_profile (devprof.jsonl windows, ISSUE 19); programs
+#     rows now carry merged program_update annotations (measured MFU,
+#     roofline verdict)
+REPORT_SCHEMA_VERSION = 2
 
 
 def _percentile(xs: List[float], q: float) -> float:
@@ -613,6 +621,85 @@ def programs_section(programs: List[Dict], lines: List[str]) -> None:
     lines.append("")
 
 
+def devprof_section(devrows: List[Dict], lines: List[str]) -> None:
+    """Device-profile windows (telemetry/devprof.py): the op-family /
+    module attribution of the LAST parsed window, plus the registry
+    reconciliation (measured MFU, roofline verdict, predicted-vs-
+    measured comm)."""
+    if not devrows:
+        return
+    ok = [r for r in devrows if r.get("status") == "ok"]
+    failures = len(devrows) - len(ok)
+    last = ok[-1] if ok else devrows[-1]
+    lines.append(f"== Device profile ({len(devrows)} window(s)"
+                 + (f", {failures} unparsed" if failures else "")
+                 + f", last @ step {last.get('step', '?')}) ==")
+    if not ok:
+        lines.append(f"last window status: {last.get('status', '?')} "
+                     f"(capture {last.get('capture', '?')}) — no "
+                     f"attributable device timeline")
+        lines.append("")
+        return
+    lines.append(f"capture:            {last.get('capture', '?')} "
+                 f"(source {last.get('source', '?')}, "
+                 f"{last.get('devices', 0)} device(s), "
+                 f"{last.get('steps', 1)} step(s) in window)")
+    tot = float(last.get("device_total_ms", 0.0))
+    lines.append(f"device time:        {tot:10.2f} ms total, "
+                 f"{float(last.get('device_ms_per_step', 0.0)):.2f} "
+                 f"ms/step")
+    coll = float(last.get("collective_ms", 0.0))
+    lines.append(f"compute/collective: "
+                 f"{float(last.get('compute_ms', 0.0)):.2f} ms / "
+                 f"{coll:.2f} ms"
+                 + (f"  (collectives {coll / tot:.1%} of device time)"
+                    if tot else ""))
+    lines.append(f"layout copies:      "
+                 f"{float(last.get('layout_copy_ms', 0.0)):.2f} ms over "
+                 f"{int(last.get('layout_copy_count', 0))} op(s); "
+                 f"fusion gaps "
+                 f"{float(last.get('fusion_gap_ms', 0.0)):.2f} ms over "
+                 f"{int(last.get('fusion_gap_count', 0))} gap(s)")
+    fams = {k: v for k, v in (last.get("families") or {}).items()
+            if isinstance(v, dict)}
+    if fams:
+        lines.append(f"{'op family':<28s} {'ms':>10s} {'%':>7s} "
+                     f"{'count':>8s}")
+        for fam in sorted(fams, key=lambda f: -float(fams[f]
+                                                     .get("ms", 0.0))):
+            ms = float(fams[fam].get("ms", 0.0))
+            lines.append(f"{fam[:28]:<28s} {ms:>10.2f} "
+                         f"{(ms / tot if tot else 0.0):>7.1%} "
+                         f"{int(fams[fam].get('count', 0)):>8d}")
+    mods = {k: float(v) for k, v in (last.get("modules") or {}).items()
+            if isinstance(v, (int, float))}
+    if mods:
+        lines.append(f"{'module':<28s} {'ms':>10s} {'%':>7s}")
+        for mod in sorted(mods, key=lambda m: -mods[m]):
+            lines.append(f"{mod[:28]:<28s} {mods[mod]:>10.2f} "
+                         f"{(mods[mod] / tot if tot else 0.0):>7.1%}")
+    mfu = last.get("measured_mfu")
+    if isinstance(mfu, (int, float)):
+        fps = last.get("measured_flops_per_s")
+        lines.append(
+            f"measured MFU:       {mfu:.1%}"
+            + (f"  ({fps:.3g} FLOP/s achieved)"
+               if isinstance(fps, (int, float)) else "")
+            + (f"  roofline: {last['roofline_verdict']} "
+               f"({last.get('roofline_basis', '?')})"
+               if last.get("roofline_verdict") else ""))
+    pred = last.get("comm_predicted_bytes")
+    if isinstance(pred, (int, float)) and pred:
+        ach = last.get("comm_achieved_bytes_per_s")
+        lines.append(
+            f"comm:               predicted {pred:.0f} B/step "
+            f"(static model), measured "
+            f"{float(last.get('comm_measured_ms', 0.0)):.2f} ms"
+            + (f" -> achieved {ach:.3g} B/s"
+               if isinstance(ach, (int, float)) else ""))
+    lines.append("")
+
+
 def counters_section(metrics: List[Dict], lines: List[str]) -> None:
     if not metrics:
         return
@@ -689,8 +776,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     programs: List[Dict] = []
     prog_path = os.path.join(directory, "programs.jsonl")
     if os.path.exists(prog_path):
-        programs = [r for r in read_jsonl(prog_path)
-                    if r.get("type") == "program"]
+        # the registry is append-only: `program_update` rows (the
+        # device profiler's measured-MFU/roofline write-back) merge
+        # into their `program` row by (kind, key)
+        by_ident: Dict = {}
+        for r in read_jsonl(prog_path):
+            ident = (r.get("kind"), r.get("key"))
+            if r.get("type") == "program":
+                by_ident[ident] = dict(r)
+                programs.append(by_ident[ident])
+            elif r.get("type") == "program_update" \
+                    and ident in by_ident:
+                by_ident[ident].update(
+                    {k: v for k, v in r.items()
+                     if k not in ("type", "kind", "key")})
+
+    devrows = []
+    dev_path = os.path.join(directory, "devprof.jsonl")
+    if os.path.exists(dev_path):
+        devrows = [r for r in read_jsonl(dev_path)
+                   if r.get("type") == "devprof"]
 
     goodput: Dict = {}
     gp_path = os.path.join(directory, "goodput.json")
@@ -772,6 +877,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                                                       0.0)))
                         if ok_traces else None)}
         doc["programs"] = programs
+        ok_rows = [r for r in devrows if r.get("status") == "ok"]
+        doc["device_profile"] = {
+            "windows": len(devrows),
+            "parse_failures": len(devrows) - len(ok_rows),
+            "last": (ok_rows[-1] if ok_rows
+                     else (devrows[-1] if devrows else None)),
+        }
         print(json.dumps(doc, indent=2))
         return 0
 
@@ -788,6 +900,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     data_health_section(metrics, quarantines, breakers, skews, lines)
     reqtrace_section(reqtraces, lines)
     programs_section(programs, lines)
+    devprof_section(devrows, lines)
     counters_section(metrics, lines)
     trace_path = os.path.join(directory, "trace.json")
     if os.path.exists(trace_path):

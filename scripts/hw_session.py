@@ -35,6 +35,10 @@ DEADLINE = float(sys.argv[2]) if len(sys.argv) > 2 else None
 PLAN = [
     ("sweep", 2700),
     ("flashtune", 1500),
+    # automated profile-window acceptance (ISSUE 19): cheap, and the
+    # only stage that exercises the cadence-triggered capture + parse
+    # + registry reconciliation path on the live backend
+    ("devprof", 600),
     # fused-epilogue micro win + the native-d re-validation: cheap, and
     # the r7 kernel work is unmeasured on hardware until these run
     ("epilogue", 900),
@@ -128,6 +132,21 @@ def main():
                       "stderr_tail": ap.stderr[-400:]})
             except Exception as e:
                 emit({"stage": "sweep_trace_analysis",
+                      "status": f"failed: {e}"})
+            # the same capture as a STRUCTURED devprof row (machine-
+            # diffable next to the text breakdown above)
+            try:
+                from flaxdiff_tpu.telemetry import devprof as _dp
+                hit, events, skipped = _dp.find_capture(
+                    out["trace_dir"])
+                if events is None:
+                    events = _dp.load_events(hit)
+                row = _dp.build_row(
+                    _dp.summarize_events(events), capture=hit,
+                    steps=5, kind="sweep", skipped_corrupt=skipped)
+                emit({"stage": "sweep_trace_devprof", "row": row})
+            except Exception as e:
+                emit({"stage": "sweep_trace_devprof",
                       "status": f"failed: {e}"})
     emit({"session_end": True})
 

@@ -1,0 +1,446 @@
+"""Device-time attribution (ISSUE 19, telemetry/devprof.py).
+
+Parser contracts run on synthetic Chrome-trace fixtures (multi-device
+lanes, named-scope module mapping, host-only and truncated captures);
+the window lifecycle and the zero-off-window-cost contract run against
+the real trainer with the PR-5 counting mocks on its sync seams; the
+serving acceptance — an ARMED profiler must not cost a warm replay a
+single retrace — runs against a real tiny pipeline.
+"""
+import gzip
+import json
+import os
+
+import numpy as np
+import pytest
+
+from flaxdiff_tpu import telemetry as T
+from flaxdiff_tpu.telemetry import devprof
+from flaxdiff_tpu.telemetry.programs import stable_json
+
+
+# ---------------------------------------------------------------------------
+# Synthetic capture fixtures
+# ---------------------------------------------------------------------------
+
+def _dev_meta(pid, name):
+    return {"ph": "M", "pid": pid, "name": "process_name",
+            "args": {"name": name}}
+
+
+def _op(pid, tid, name, ts, dur, args=None):
+    return {"ph": "X", "pid": pid, "tid": tid, "name": name,
+            "ts": ts, "dur": dur, "args": args or {}}
+
+
+def _device_events():
+    """One device lane: 7750 µs of leaf ops (attn 6000, fusion 1000,
+    all-reduce 500, copy 250), envelopes that must NOT double-count,
+    and a host lane that must be ignored."""
+    scope = {"tf_op": "jit(train_step)/unet/attn1/dot_general"}
+    return [
+        _dev_meta(3, "/device:TPU:0"),
+        _dev_meta(9, "/host:CPU"),
+        # envelopes: a jit_* wrapper and a bare step number
+        _op(3, 1, "jit_train_step", 0, 99999),
+        _op(3, 1, "7", 0, 99999),
+        # leaf ops (gaps: 1000 @ 4000, 500 @ 7000, 500 @ 8500,
+        # 500 @ 9500 -> 2500 µs over 4 gaps)
+        _op(3, 1, "attn1.2", 0, 4000, scope),
+        _op(3, 1, "attn1.3", 5000, 2000, scope),
+        _op(3, 1, "fusion.7", 7500, 1000,
+            {"tf_op": "jit(train_step)/unet/mlp/add"}),
+        _op(3, 1, "all-reduce.1", 9000, 500,
+            {"tf_op": "jit(train_step)/mesh/psum"}),
+        _op(3, 1, "copy.3", 10000, 250),
+        # host-side work must not leak into the device totals
+        _op(9, 1, "callback", 0, 12345),
+    ]
+
+
+def _write_gz(path, events):
+    with gzip.open(str(path), "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return str(path)
+
+
+# ---------------------------------------------------------------------------
+# Parser contracts
+# ---------------------------------------------------------------------------
+
+def test_families_sum_to_device_total_and_splits():
+    s = devprof.summarize_events(_device_events())
+    assert s["source"] == "device"
+    assert s["device_total_us"] == 7750
+    fam_sum = sum(v["us"] for v in s["families"].values())
+    assert fam_sum == s["device_total_us"]     # the core invariant
+    assert s["families"]["attn"] == {"us": 4000 + 2000, "count": 2}
+    assert s["collective_us"] == 500 and s["collective_count"] == 1
+    assert s["compute_us"] == 7750 - 500
+    assert s["layout_copy_us"] == 250 and s["layout_copy_count"] == 1
+    assert s["fusion_gap_us"] == 2500 and s["fusion_gap_count"] == 4
+    # envelope events and host-lane events contributed nothing
+    assert "jit_train_step" not in s["families"]
+    assert "callback" not in s["families"]
+
+
+def test_module_attribution_scopes_and_fallbacks():
+    s = devprof.summarize_events(_device_events())
+    # named-scope path: first non-wrapper segment is the model module
+    assert s["modules"]["unet"] == 7000
+    assert s["modules"]["mesh"] == 500
+    # no scope metadata at all -> unattributed
+    assert s["modules"]["unattributed"] == 250
+    # hlo_module fallback (the CPU backend surfaces no scopes)
+    assert devprof.module_of({"hlo_module": "jit_f",
+                              "hlo_op": "dot.3"}) == "jit_f"
+    assert devprof.module_of({}) == "unattributed"
+
+
+def test_multi_device_lanes_gap_per_lane():
+    """Fusion gaps are PER LANE: two devices running the same schedule
+    must not manufacture gaps out of cross-lane interleaving."""
+    events = [_dev_meta(3, "/device:TPU:0"), _dev_meta(4, "/device:TPU:1")]
+    for pid in (3, 4):
+        events += [_op(pid, 1, "fusion.1", 0, 100),
+                   _op(pid, 1, "fusion.2", 150, 100)]
+    s = devprof.summarize_events(events)
+    assert len(s["devices"]) == 2 and s["lanes"] == 2
+    assert s["device_total_us"] == 400
+    assert s["fusion_gap_us"] == 100 and s["fusion_gap_count"] == 2
+
+
+def test_host_xla_capture_classified_not_conflated():
+    """A CPU-backend capture (no device pid, XLA op events with an
+    hlo_op arg) is `host_xla` — attributable, distinct from
+    `host_only`."""
+    events = [_dev_meta(9, "/host:CPU"),
+              _op(9, 2, "dot.3", 0, 500,
+                  {"hlo_module": "jit_f", "hlo_op": "dot.3"})]
+    source, ops = devprof.select_op_events(events)
+    assert source == "host_xla" and len(ops) == 1
+    assert devprof.summarize_events(
+        [_dev_meta(9, "/host:CPU"),
+         _op(9, 2, "anything", 0, 500)])["source"] == "host_only"
+
+
+def test_find_capture_skips_and_reports_corrupt(tmp_path):
+    logdir = tmp_path / "w"
+    logdir.mkdir()
+    good = _write_gz(logdir / "a.trace.json.gz", _device_events())
+    bad = logdir / "z.trace.json.gz"          # sorts newest
+    bad.write_bytes(b"\x1f\x8b\x08\x00truncated")
+    hit, events, skipped = devprof.find_capture(str(logdir))
+    assert hit == good and events is not None
+    assert skipped == [str(bad)]
+    with pytest.raises(SystemExit, match="no .*trace"):
+        devprof.find_capture(str(tmp_path / "empty"))
+
+
+def test_corrupt_only_window_yields_skipped_corrupt_row(tmp_path):
+    logdir = tmp_path / "w"
+    logdir.mkdir()
+    (logdir / "a.trace.json.gz").write_bytes(b"not gzip at all")
+    row = devprof.profile_window_row(str(logdir), steps=4,
+                                     kind="train_step", key="k")
+    assert row["status"] == "skipped_corrupt"
+    assert len(row["skipped_corrupt"]) == 1
+    assert row["skipped_corrupt"][0].startswith("a.trace.json.gz")
+    assert row["device_total_ms"] == 0.0
+
+
+def test_row_byte_stable_and_roundtrips(tmp_path):
+    s = devprof.summarize_events(_device_events())
+    kw = dict(capture="x/y/t.trace.json.gz", steps=4,
+              kind="train_step", key="k1", window=2, step=16)
+    a = devprof.build_row(s, **kw)
+    b = devprof.build_row(devprof.summarize_events(_device_events()),
+                          **kw)
+    assert stable_json(a) == stable_json(b)    # byte-stable contract
+    assert a["capture"] == "t.trace.json.gz"   # basename, no abs paths
+    assert a["device_total_ms"] == 7.75
+    assert a["device_ms_per_step"] == round(7.75 / 4, 3)
+    # family ms keep summing to the total after the ms conversion
+    assert sum(v["ms"] for v in a["families"].values()) \
+        == pytest.approx(a["device_total_ms"])
+    path = tmp_path / "devprof.jsonl"
+    devprof.append_row(str(path), a)
+    devprof.append_row(str(path), b)
+    assert devprof.read_devprof(str(path)) == [a, b]
+
+
+def test_reconcile_mfu_comm_and_verdicts():
+    s = devprof.summarize_events(_device_events())
+    row = devprof.build_row(s, steps=4)
+    program = {"kind": "train_step", "key": "k", "flops_jaxpr": 1e9,
+               "flops_cost": 1e9, "bytes_cost": 1e5,
+               "comm_bytes_by_axis": {"data": 4096}}
+    out = devprof.reconcile(row, program, peak_flops=1e12,
+                            peak_bytes_per_s=1e11)
+    per_step_s = (7.75 / 4) / 1e3
+    assert out["measured_flops_per_s"] == pytest.approx(1e9 / per_step_s)
+    assert out["measured_mfu"] == pytest.approx(1e9 / per_step_s / 1e12)
+    # collectives are 500/7750 < 40% -> intensity decides: 1e9/1e5 =
+    # 1e4 FLOP/byte vs ridge 1e12/1e11 = 10 -> compute-bound
+    assert out["roofline_verdict"] == "compute-bound"
+    assert out["roofline_basis"] == "intensity_vs_ridge"
+    # predicted 4096 B x 4 steps over 0.5 ms of collectives
+    assert out["comm_predicted_bytes"] == 4096
+    assert out["comm_achieved_bytes_per_s"] == \
+        pytest.approx(4096 * 4 / (0.5 / 1e3))
+    # comm-bound dominates when collectives eat the window
+    out2 = devprof.reconcile(row, program, peak_flops=1e12,
+                             peak_bytes_per_s=1e11,
+                             comm_bound_fraction=0.05)
+    assert out2["roofline_verdict"] == "comm-bound"
+    assert out2["roofline_basis"] == "collective_fraction"
+
+
+def test_registry_annotation_merges_on_read(tmp_path):
+    reg = T.ProgramRegistry(str(tmp_path / "programs.jsonl"))
+    reg.record("train_step", "k1", compile_ms=5.0, flops_jaxpr=1e9)
+    assert reg.annotate("train_step", "k1",
+                        {"measured_mfu": 0.25,
+                         "roofline_verdict": "memory-bound"}) is not None
+    # orphan updates (never-registered identity) are dropped, not rows
+    assert reg.annotate("train_step", "ghost",
+                        {"measured_mfu": 0.1}) is None
+    rows = T.read_registry(str(tmp_path / "programs.jsonl"))
+    assert len(rows) == 1
+    assert rows[0]["measured_mfu"] == 0.25
+    assert rows[0]["roofline_verdict"] == "memory-bound"
+    assert rows[0]["compile_ms"] == 5.0        # merge, not replace
+
+
+# ---------------------------------------------------------------------------
+# Window lifecycle (no backend needed)
+# ---------------------------------------------------------------------------
+
+def test_window_state_machine_and_trigger(tmp_path):
+    trig = tmp_path / "trigger"
+    p = devprof.DeviceProfiler(str(tmp_path / "devprof.jsonl"),
+                               cadence=10, window=3,
+                               trigger_path=str(trig))
+    assert not p.should_open(7) and p.should_open(10)
+    assert not p.active()
+    # trigger file arms a one-shot window and is CONSUMED
+    assert not p.poll_trigger()
+    trig.write_text("")
+    assert p.poll_trigger() and not trig.exists()
+    assert p.should_open(7)                    # armed overrides cadence
+    # close-before-dispatch: a window opened at s covers s..s+w-1
+    p._open_at = 10
+    assert not p.should_close(12) and p.should_close(13)
+
+
+# ---------------------------------------------------------------------------
+# Trainer integration (real fits, CPU backend)
+# ---------------------------------------------------------------------------
+
+def _make_trainer(mesh, telemetry=None, **cfg_kw):
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import optax
+
+    from flaxdiff_tpu.predictors import EpsilonPredictionTransform
+    from flaxdiff_tpu.schedulers import CosineNoiseSchedule
+    from flaxdiff_tpu.trainer import DiffusionTrainer, TrainerConfig
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, t, cond=None):
+            h = nn.Conv(8, (3, 3))(x)
+            return nn.Conv(x.shape[-1], (3, 3))(jnp.tanh(h))
+
+    model = Tiny()
+
+    def apply_fn(params, x, t, cond):
+        return model.apply({"params": params}, x, t, None)
+
+    def init_fn(key):
+        return model.init(key, jnp.zeros((1, 8, 8, 1)),
+                          jnp.zeros((1,)))["params"]
+
+    return DiffusionTrainer(
+        apply_fn=apply_fn, init_fn=init_fn, tx=optax.adam(1e-3),
+        schedule=CosineNoiseSchedule(timesteps=100),
+        transform=EpsilonPredictionTransform(), mesh=mesh,
+        config=TrainerConfig(normalize=False, **cfg_kw),
+        telemetry=telemetry)
+
+
+def _data(rng, batch=8):
+    while True:
+        yield {"sample": rng.normal(size=(batch, 8, 8, 1))
+               .astype(np.float32)}
+
+
+def test_cadence_window_end_to_end(mesh, rng, tmp_path, monkeypatch,
+                                   capsys):
+    """THE acceptance path on CPU: a cadence-triggered window during a
+    real fit parses into a devprof.jsonl row whose families sum to the
+    profiled device total (±1%), joins its program-registry row
+    (measured MFU + predicted-vs-measured comm populated), books its
+    overhead to the `profile` phase/badput bucket, and renders in
+    diagnose_run text and --json."""
+    monkeypatch.setenv("FLAXDIFF_PEAK_FLOPS", "1e12")
+    tel = T.Telemetry.create(str(tmp_path / "tel"))
+    trainer = _make_trainer(mesh, telemetry=tel, log_every=8,
+                            telemetry_sample_every=4, pipeline_depth=16,
+                            profile_cadence=5, profile_steps=2)
+    hist = trainer.fit(_data(rng), total_steps=8)
+    tel.close()
+    assert np.isfinite(hist["final_loss"])
+
+    rows = T.read_devprof(str(tmp_path / "tel" / "devprof.jsonl"))
+    assert len(rows) == 1                      # opened @5, closed @7
+    row = rows[0]
+    assert row["status"] == "ok"
+    assert row["kind"] == "train_step" and row["key"]
+    assert row["step"] == 5 and row["steps"] == 2
+    fam_ms = sum(v["ms"] for v in row["families"].values())
+    assert fam_ms == pytest.approx(row["device_total_ms"], rel=0.01)
+    assert row["measured_mfu"] is not None
+    assert row["roofline_verdict"] in ("compute-bound", "memory-bound",
+                                       "comm-bound")
+    assert "comm_predicted_bytes" in row
+    # the write-back annotation reached the registry row
+    regs = [r for r in T.read_registry(
+                str(tmp_path / "tel" / "programs.jsonl"))
+            if r.get("kind") == "train_step"]
+    annotated = [r for r in regs if r.get("measured_mfu") is not None]
+    assert annotated and annotated[0]["key"] == row["key"]
+    # window overhead landed in its own goodput bucket
+    assert hist["goodput"]["badput_s"].get("profile", 0.0) > 0
+    # counters for the live dashboards
+    snap = {}
+    for rec in [json.loads(x) for x in
+                open(tmp_path / "tel" / "telemetry.jsonl")]:
+        if rec.get("type") == "metrics":
+            snap = rec
+    assert snap.get("devprof/windows") == 1
+    assert "devprof/last_device_ms_per_step" in snap
+
+    from scripts.diagnose_run import main as diagnose
+    assert diagnose([str(tmp_path / "tel"), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["device_profile"]["windows"] == 1
+    assert doc["device_profile"]["last"]["measured_mfu"] is not None
+    # the merged program rows carry the annotation in --json too
+    assert any(p.get("measured_mfu") is not None
+               for p in doc["programs"])
+    assert diagnose([str(tmp_path / "tel")]) == 0
+    out = capsys.readouterr().out
+    assert "== Device profile" in out and "measured MFU" in out
+
+
+def test_offwindow_steps_add_zero_syncs(mesh, rng, tmp_path,
+                                        monkeypatch):
+    """The zero-off-window-cost contract, counted at the trainer's only
+    sync seams: an ARMED-BUT-IDLE profiler (trigger configured, never
+    fired) adds NOTHING to the ISSUE-5 baseline (3 blocks, 1 fetch over
+    8 steps); a window that closes in-loop adds EXACTLY the one drain
+    its close needs."""
+    from flaxdiff_tpu.trainer import trainer as trainer_mod
+
+    class Counting:
+        def __init__(self, real):
+            self.real, self.calls = real, 0
+
+        def __call__(self, *a, **k):
+            self.calls += 1
+            return self.real(*a, **k)
+
+    def run(tel_dir, **profile_kw):
+        block = Counting(trainer_mod._block_until_ready)
+        fetch = Counting(trainer_mod._fetch_losses)
+        monkeypatch.setattr(trainer_mod, "_block_until_ready", block)
+        monkeypatch.setattr(trainer_mod, "_fetch_losses", fetch)
+        tel = T.Telemetry.create(str(tmp_path / tel_dir))
+        trainer = _make_trainer(mesh, telemetry=tel, log_every=8,
+                                telemetry_sample_every=4,
+                                pipeline_depth=16, **profile_kw)
+        hist = trainer.fit(_data(rng), total_steps=8)
+        tel.close()
+        assert np.isfinite(hist["final_loss"])
+        return block.calls, fetch.calls
+
+    # armed but idle: the trigger file never appears — byte-identical
+    # sync schedule to the ISSUE-5 baseline
+    blocks, fetches = run(
+        "idle", profile_trigger=str(tmp_path / "never-fired"))
+    assert blocks == 3 and fetches == 1
+    # one window (open @5, close @7): exactly ONE extra drain, still
+    # one loss fetch — no off-window step paid anything
+    blocks, fetches = run("armed", profile_cadence=5, profile_steps=2)
+    assert blocks == 4 and fetches == 1
+    rows = T.read_devprof(str(tmp_path / "armed" / "devprof.jsonl"))
+    assert len(rows) == 1
+
+
+# ---------------------------------------------------------------------------
+# Serving integration: armed profiling must stay retrace-free
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_pipe():
+    import jax
+    import jax.numpy as jnp
+
+    from flaxdiff_tpu.inference import (DiffusionInferencePipeline,
+                                        build_model)
+    config = {
+        "model": {"name": "simple_dit", "emb_features": 32,
+                  "num_heads": 4, "num_layers": 1, "patch_size": 4,
+                  "output_channels": 1},
+        "schedule": {"name": "cosine", "timesteps": 100},
+        "predictor": "epsilon",
+    }
+    model = build_model("simple_dit", emb_features=32, num_heads=4,
+                        num_layers=1, patch_size=4, output_channels=1)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 1)),
+                        jnp.zeros((1,)), None)
+    return DiffusionInferencePipeline.from_config(config, params=params)
+
+
+def test_serving_armed_profiler_warm_replay_zero_retraces(tiny_pipe,
+                                                          tmp_path):
+    """ISSUE 19 serving acceptance: a warm replay with profiling armed
+    on round cadence keeps `serving/program_cache_misses` flat (the
+    profiler hook is host-only — it must never touch the program
+    cache) while still writing per-round devprof rows."""
+    from flaxdiff_tpu.serving import (SampleRequest, SchedulerConfig,
+                                      ServingScheduler)
+    tel = T.Telemetry(enabled=False)
+    prof = devprof.DeviceProfiler(str(tmp_path / "devprof.jsonl"),
+                                  cadence=2, window=1,
+                                  logdir=str(tmp_path / "traces"))
+    sched = ServingScheduler(
+        pipeline=tiny_pipe, telemetry=tel, autostart=False,
+        config=SchedulerConfig(round_steps=2, batch_buckets=(1, 2)),
+        profiler=prof)
+
+    def pass_once():
+        futs = [sched.submit(SampleRequest(
+            resolution=8, channels=1, diffusion_steps=n, sampler="ddim",
+            seed=s, use_ema=False)) for n, s in ((3, 1), (3, 2))]
+        sched.start()
+        return [f.result(timeout=300) for f in futs]
+
+    first = pass_once()
+    misses_cold = tel.registry.counter(
+        "serving/program_cache_misses").value
+    assert misses_cold > 0
+    second = pass_once()
+    sched.close()
+    if prof.active():                          # window spans shutdown
+        prof.close(extra={"owner": "serving"})
+    # re_traces == 0 across the warm replay
+    assert tel.registry.counter(
+        "serving/program_cache_misses").value == misses_cold
+    # profiling armed did not change the samples either
+    for a, b in zip(first, second):
+        np.testing.assert_array_equal(a.samples, b.samples)
+    rows = T.read_devprof(str(tmp_path / "devprof.jsonl"))
+    assert rows and all(r["owner"] == "serving" for r in rows)
+    assert any(r["status"] == "ok" for r in rows)

@@ -479,22 +479,45 @@ class TestMemoryMonitor:
         stats["bytes_in_use"] = 300
         assert mon.sample()["memory/step_watermark_bytes"] == 300
 
-    def test_backends_without_stats_disable_quietly(self):
+    def test_backends_without_stats_fall_back_to_host_rss(self):
+        """Off-TPU the monitor no longer goes dark: it reports process
+        RSS from /proc/self/statm — and the host keys are DISJOINT
+        from the HBM keys, so an HBM probe reads None, never a host
+        number masquerading as device memory."""
         for dev in (self._Dev(None), self._Dev(RuntimeError("no stats"))):
             mon = T.MemoryMonitor(devices=[dev])
-            assert mon.sample() == {}
-            assert mon.disabled
-            assert mon.record(T.MetricsRegistry()) == {}
+            s = mon.sample()
+            assert not mon.disabled
+            assert s["memory/host_rss_bytes"] > 0
+            assert s["memory/host_vms_bytes"] >= s["memory/host_rss_bytes"]
+            assert s["memory/host_rss_peak_bytes"] >= \
+                s["memory/host_rss_bytes"]
+            assert "memory/bytes_in_use" not in s
+            assert s.get("memory/peak_bytes_in_use") is None
+            reg = T.MetricsRegistry()
+            mon.record(reg)
+            assert reg.snapshot()["memory/host_rss_bytes"] > 0
+
+    def test_no_stats_and_no_procfs_disables_quietly(self, tmp_path):
+        """Non-Linux shape: no allocator stats AND no statm file —
+        the old disabled latch stands."""
+        mon = T.MemoryMonitor(devices=[self._Dev(None)],
+                              statm_path=str(tmp_path / "missing"))
+        assert mon.sample() == {}
+        assert mon.disabled
+        assert mon.record(T.MetricsRegistry()) == {}
 
     def test_real_backend_smoke(self):
-        """Whatever this backend reports (CPU: nothing), sampling and
+        """Whatever this backend reports (CPU: host RSS), sampling and
         recording must not raise."""
         mon = T.MemoryMonitor()
         reg = T.MetricsRegistry()
         out = mon.record(reg)
         assert isinstance(out, dict)
-        if out:
+        if "memory/bytes_in_use" in out:
             assert out["memory/bytes_in_use"] >= 0
+        elif out:
+            assert out["memory/host_rss_bytes"] > 0
 
 
 # -- per-module update-ratio z-scoring (ISSUE 9 satellite) ---------------------

@@ -469,6 +469,43 @@ def test_compare_runs_flags_attainment_drop_and_new_incidents(
     assert "REGRESSION" in out and "attainment" in out
 
 
+def _devprof_dir(tmp_path, name, attn_ms, mfu):
+    d = tmp_path / name
+    d.mkdir()
+    row = {"type": "devprof", "status": "ok", "source": "device",
+           "capture": "t.trace.json.gz", "step": 8, "steps": 4,
+           "device_total_ms": (attn_ms + 1.0) * 4,
+           "device_ms_per_step": attn_ms + 1.0,
+           "collective_ms": 0.5, "collective_count": 2,
+           "compute_ms": attn_ms, "layout_copy_ms": 0.1,
+           "layout_copy_count": 1, "fusion_gap_ms": 0.2,
+           "fusion_gap_count": 1, "measured_mfu": mfu,
+           "families": {"attn": {"ms": attn_ms, "count": 4}}}
+    (d / "devprof.jsonl").write_text(json.dumps(row) + "\n")
+    return str(d)
+
+
+def test_compare_runs_devprof_direction_contract(tmp_path, capsys):
+    """Contract (ISSUE 19): op-family device ms regress UP, measured
+    MFU regresses DOWN, op counts are neutral program-shape facts."""
+    from scripts.compare_runs import main
+    a = _devprof_dir(tmp_path, "a", attn_ms=4.0, mfu=0.4)
+    b = _devprof_dir(tmp_path, "b", attn_ms=8.0, mfu=0.2)
+    assert main([a, b, "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    regs = {(r["stage"], r["metric"]) for r in doc["regressions"]}
+    assert ("devprof", "devprof/families/attn_ms") in regs
+    assert ("devprof", "devprof/measured_mfu") in regs
+    rows = {r["metric"]: r for r in doc["stages"]["devprof"]["rows"]}
+    assert rows["devprof/measured_mfu"]["direction"] == "down_is_worse"
+    assert rows["devprof/device_ms_per_step"]["direction"] \
+        == "up_is_worse"
+    assert rows["devprof/families/attn_count"]["direction"] == "info"
+    assert rows["devprof/collective_count"]["direction"] == "info"
+    # the same deltas in the other direction are improvements
+    assert main([b, a, "--json"]) == 0
+
+
 # ---------------------------------------------------------------------------
 # diagnose_run: schema_version pin + SLO / Incidents sections
 # ---------------------------------------------------------------------------
@@ -498,12 +535,17 @@ def test_diagnose_json_schema_pinned_and_incident_sections(
 
     assert main([str(tmp_path), "--json"]) == 0
     doc = json.loads(capsys.readouterr().out)
-    assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 1
+    assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 2
     assert set(doc) == {"schema_version", "goodput", "steps",
                         "phase_rows", "step_wall_s", "pod_last",
                         "health", "elasticity", "frontdoor", "slo",
                         "incidents", "data_health", "request_traces",
-                        "programs"}
+                        "programs", "device_profile"}
+    # no profile windows ran: the stanza is present but empty (the
+    # key set is the contract, not conditional)
+    assert doc["device_profile"] == {"windows": 0,
+                                     "parse_failures": 0,
+                                     "last": None}
     assert doc["slo"]["slo/attainment/t0"] == 0.5
     assert len(doc["incidents"]) == 1
     inc = doc["incidents"][0]
