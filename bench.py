@@ -1428,6 +1428,155 @@ def stage_devprof(args) -> dict:
     return res
 
 
+def stage_plan(args) -> dict:
+    """ISSUE 20 acceptance: the measurement-driven parallelism planner
+    runs its full loop on a forced 8-way CPU mesh with a real tiny
+    SimpleDiT — enumerate the factorization x rule-table space, prune
+    on coverage + the HBM envelope, rank by the comm-proxy byte bill,
+    probe the shortlist through the REAL DiffusionTrainer dispatch
+    path (timed short fits under each candidate mesh + rule table),
+    land the decision in the program registry, then re-plan on the
+    warm cache and show ZERO probes."""
+    # the search space needs devices to factor over; on hosts without
+    # accelerators the cpu backend defaults to 1 device
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    _apply_jax_platforms()
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from flaxdiff_tpu import telemetry as T
+    from flaxdiff_tpu.models.dit import SimpleDiT
+    from flaxdiff_tpu.parallel import create_mesh
+    from flaxdiff_tpu.parallel.planner import (CandidatePlan,
+                                               ParallelPlanner,
+                                               evaluate_candidate)
+    from flaxdiff_tpu.predictors import EpsilonPredictionTransform
+    from flaxdiff_tpu.schedulers import CosineNoiseSchedule
+    from flaxdiff_tpu.trainer import DiffusionTrainer, TrainerConfig
+
+    model = SimpleDiT(output_channels=1, patch_size=2, emb_features=32,
+                      num_layers=2, num_heads=2, backend="xla")
+
+    def apply_fn(params, x, t, cond):
+        return model.apply({"params": params}, x, t, None)
+
+    def init_fn(key):
+        return model.init(key, jnp.zeros((1, 16, 16, 1)),
+                          jnp.zeros((1,)), None)["params"]
+
+    shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    total = sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                for l in jax.tree_util.tree_leaves(shapes))
+    devices = list(jax.devices())
+    batch_shape = (8, 16, 16, 1)
+    # tiny model, tiny thresholds: leaves are far below the production
+    # 64 KiB partition floor, and a ~3x-params budget forces the HBM
+    # prune branch to actually fire
+    min_size, hbm_budget = 2 ** 8, total * 3.0
+
+    rng = np.random.default_rng(0)
+    batches = [{"sample": rng.normal(size=batch_shape)
+                .astype(np.float32)} for _ in range(2)]
+
+    def data():
+        i = 0
+        while True:
+            yield batches[i % len(batches)]
+            i += 1
+
+    probe_log = []
+
+    def probe(ev):
+        # the dispatch-path probe: a real trainer under the candidate's
+        # mesh + rule table, one fit to compile, a short timed fit after
+        mesh = create_mesh(axes=dict(ev.axes), devices=devices)
+        trainer = DiffusionTrainer(
+            apply_fn=apply_fn, init_fn=init_fn, tx=optax.adam(1e-3),
+            schedule=CosineNoiseSchedule(timesteps=100),
+            transform=EpsilonPredictionTransform(), mesh=mesh,
+            partition_rules=ev.rules,
+            config=TrainerConfig(normalize=False, log_every=50))
+        trainer.fit(data(), total_steps=1)
+        steps = 3
+        t0 = time.perf_counter()
+        trainer.fit(data(), total_steps=steps)
+        ms = (time.perf_counter() - t0) / steps * 1e3
+        probe_log.append({"plan": ev.name, "ms": round(ms, 3)})
+        return ms
+
+    tmp = tempfile.mkdtemp(prefix="bench_plan_")
+    res = {"platform": jax.devices()[0].platform,
+           "devices": len(devices)}
+    try:
+        tele = T.Telemetry.create(tmp)
+        planner = ParallelPlanner(cache_dir=tmp, probe_fn=probe,
+                                  metrics=tele, min_size=min_size)
+        decision = planner.plan(shapes, devices=devices,
+                                batch_shape=batch_shape,
+                                hbm_bytes=hbm_budget)
+        planner.commit(tele.programs, decision)
+        tele.close()
+
+        res.update({
+            "chosen": decision.name, "candidates": decision.candidates,
+            "pruned_unmatched": decision.pruned_unmatched,
+            "pruned_hbm": decision.pruned_hbm,
+            "pruned_comm": decision.pruned_comm,
+            "probes_cold": planner.probe_count,
+            "shortlist": list(decision.shortlist),
+            "probe_ms": decision.probe_ms,
+            "comm_bytes": decision.comm_bytes,
+            "comm_bytes_by_axis": dict(decision.comm_bytes_by_axis),
+            "hbm_estimate_bytes": decision.hbm_estimate_bytes,
+            "probe_log": probe_log})
+
+        # the hand-tuned default a planner must at least match: the
+        # data2 x fsdp2 x tensor2 cube on the inferred rule table
+        base = evaluate_candidate(
+            CandidatePlan(axes=(("data", 2), ("fsdp", 2), ("tensor", 2)),
+                          table="inferred"),
+            shapes, devices, min_size=min_size, batch_shape=batch_shape)
+        if base is not None:
+            res["baseline_comm_bytes"] = base.comm_bytes
+            res["beats_baseline"] = bool(
+                decision.comm_bytes <= base.comm_bytes)
+
+        # warm-cache contract: a fresh planner over the same cache dir
+        # must return the SAME plan without invoking probe_fn at all
+        warm = ParallelPlanner(cache_dir=tmp, probe_fn=probe,
+                               min_size=min_size)
+        warm_decision = warm.plan(shapes, devices=devices,
+                                  batch_shape=batch_shape,
+                                  hbm_bytes=hbm_budget)
+        res["warm_cache_hit"] = bool(warm_decision.cache_hit)
+        res["probes_warm"] = warm.probe_count
+        res["warm_same_plan"] = bool(warm_decision.name == decision.name)
+
+        rows = [r for r in T.read_registry(os.path.join(tmp,
+                                                        "programs.jsonl"))
+                if r.get("kind") == "plan"]
+        res["registry_rows"] = len(rows)
+        res["registry_annotated"] = sum(
+            1 for r in rows if r.get("plan_chosen"))
+        log(f"plan: {decision.candidates} candidates, pruned "
+            f"{decision.pruned_unmatched}/{decision.pruned_hbm}"
+            f"/{decision.pruned_comm} (unmatched/hbm/comm), "
+            f"{planner.probe_count} cold probes -> {decision.name}; "
+            f"warm hit={res['warm_cache_hit']} "
+            f"probes={res['probes_warm']}")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return res
+
+
 def stage_data_chaos(args) -> dict:
     """ISSUE 17 acceptance: the deterministic data plane under REAL
     injected corruption + a step.nan rollback, measured end to end.
@@ -2222,7 +2371,8 @@ STAGES = {"flashtune": stage_flashtune, "sweep": stage_sweep,
           "ablate": stage_ablate, "longseq": stage_longseq,
           "dispatch": stage_dispatch, "epilogue": stage_epilogue,
           "serve": stage_serve, "diffcache": stage_diffcache,
-          "data_chaos": stage_data_chaos, "devprof": stage_devprof}
+          "data_chaos": stage_data_chaos, "devprof": stage_devprof,
+          "plan": stage_plan}
 
 # info-value order (VERDICT r3 next #1): the headline sweep first, its
 # baseline second; refreal anchors vs_reference_binary; dispatch is the
@@ -2230,8 +2380,8 @@ STAGES = {"flashtune": stage_flashtune, "sweep": stage_sweep,
 # cheap and unblocks the tuned micros; ddim is the BASELINE.md
 # inference target; the rest are diagnostics.
 STAGE_ORDER = ("sweep", "ref", "refreal", "dispatch", "devprof",
-               "serve", "diffcache", "flashtune", "ddim", "attnpad",
-               "epilogue", "ablate", "sweep256", "longseq")
+               "plan", "serve", "diffcache", "flashtune", "ddim",
+               "attnpad", "epilogue", "ablate", "sweep256", "longseq")
 
 # rough healthy-tunnel cost estimates (seconds) for budget scheduling —
 # a stage is skipped when the remaining budget can't cover its MINIMUM
@@ -2265,7 +2415,12 @@ STAGE_EST = {"sweep": 900, "ref": 450, "refreal": 700, "flashtune": 500,
              "data_chaos": 180,
              # one tiny-model 40-step fit with two cadence-triggered
              # profiler windows + the capture parse (host-side)
-             "devprof": 120}
+             "devprof": 120,
+             # the planner search is static (jaxpr traces, nothing
+             # compiled) but each shortlist probe is a fresh tiny-DiT
+             # trainer compile + a 4-step fit under its candidate mesh;
+             # the warm re-plan is cache-only
+             "plan": 240}
 
 # stages that receive the flashtune winner env. Headline stages
 # (sweep/ref/ddim/sweep256) run with code defaults: an unvalidated

@@ -52,9 +52,9 @@ class HostSyncRule(AstRule):
     id = "host-sync"
     doc = ("host synchronization outside the blessed "
            "_block_until_ready/_fetch_losses/_device_get/_host_asarray "
-           "seams in trainer/, serving/, samplers/, data/")
+           "seams in trainer/, serving/, samplers/, data/, parallel/")
     roots = ("flaxdiff_tpu",)
-    dirs = ("trainer", "serving", "samplers", "data")
+    dirs = ("trainer", "serving", "samplers", "data", "parallel")
 
     BLESSED = frozenset({"_block_until_ready", "_fetch_losses",
                          "_fetch_ring", "_fetch_gate_events",

@@ -35,6 +35,12 @@ ALLOWLIST: Dict[str, Dict[str, int]] = {
         # seam; a sync inside devprof.py would tax every step the
         # profiler merely watches
         "flaxdiff_tpu/telemetry/devprof.py": 0,
+        # the auto-parallelism planner is static search by contract:
+        # explicit ZERO pin (ISSUE 20) — enumeration, coverage pruning,
+        # and the comm proxy never touch a device (make_jaxpr over
+        # abstract shapes); the measured probes sync through the one
+        # blessed `_block_until_ready` seam
+        "flaxdiff_tpu/parallel/planner.py": 0,
         "flaxdiff_tpu/serving/loadgen.py": 2,
         "flaxdiff_tpu/trainer/autoencoder_trainer.py": 4,
         "flaxdiff_tpu/trainer/logging.py": 2,

@@ -30,6 +30,15 @@ from .ulysses import (
     ulysses_attention_sharded,
     ulysses_self_attention,
 )
+from .planner import (
+    CandidatePlan,
+    ParallelPlanner,
+    PlanDecision,
+    enumerate_candidates,
+    generate_rules,
+    resolve_plan,
+    tree_signature,
+)
 from .partition import (
     LeafAssignment,
     PartitionRule,
@@ -60,6 +69,13 @@ __all__ = [
     "sequence_sharding",
     "local_batch_size",
     "mesh_shape_for",
+    "CandidatePlan",
+    "ParallelPlanner",
+    "PlanDecision",
+    "enumerate_candidates",
+    "generate_rules",
+    "resolve_plan",
+    "tree_signature",
     "LeafAssignment",
     "PartitionRule",
     "match_partition_rules",

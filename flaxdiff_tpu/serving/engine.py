@@ -540,3 +540,43 @@ class SamplerProgramEngine:
         self.telemetry.counter("serving/prewarm_programs").inc(programs)
         self.telemetry.gauge("serving/prewarm_ms").set(seconds * 1e3)
         return {"programs": programs, "seconds": seconds}
+
+    def plan_parallelism(self, param_shapes=None, batch_shape=None,
+                         devices=None, probe_fn=None, **plan_kwargs):
+        """The chips-per-request vs requests-per-chip decision from the
+        same measured search the trainer uses (`parallel/planner.py`),
+        with optimizer/EMA multipliers zeroed — inference holds params
+        only, so far more aggressive replication fits per chip and the
+        planner decides from HBM + comm evidence whether one request
+        should span chips (tensor/fsdp axes) or each chip should take
+        its own requests (data axis). The decision is committed to the
+        program registry under kind "plan_infer" so
+        `scripts/compare_runs.py` diffs serving layout decisions like
+        any other program evidence. Returns the `PlanDecision`;
+        `decision.chips_per_request` is the layout answer."""
+        import os
+
+        from ..parallel.planner import CACHE_ENV, ParallelPlanner
+        if param_shapes is None:
+            params = getattr(self.pipeline, "params", None)
+            if params is None:
+                raise ValueError("plan_parallelism needs param_shapes "
+                                 "when the pipeline carries no params")
+            param_shapes = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(
+                    tuple(getattr(x, "shape", ())),
+                    getattr(x, "dtype", jnp.float32)), params)
+        ctor = {}
+        if "min_size" in plan_kwargs:
+            ctor["min_size"] = plan_kwargs.pop("min_size")
+        planner = ParallelPlanner(
+            cache_dir=os.environ.get(CACHE_ENV) or None,
+            probe_fn=probe_fn, metrics=self.telemetry,
+            opt_mult=0.0, ema_mult=0.0, **ctor)
+        plan_kwargs.setdefault("include_pipeline", False)
+        decision = planner.plan(param_shapes, batch_shape=batch_shape,
+                                devices=devices, **plan_kwargs)
+        registry = getattr(self.telemetry, "programs", None)
+        if registry is not None:
+            planner.commit(registry, decision, kind="plan_infer")
+        return decision

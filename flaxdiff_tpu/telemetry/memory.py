@@ -46,6 +46,32 @@ log = logging.getLogger("flaxdiff_tpu.telemetry")
 
 _STATM_PATH = "/proc/self/statm"
 
+# Per-chip HBM capacity override for the auto-parallelism planner's
+# HBM-fit pruning (parallel/planner.py) — the devprof
+# FLAXDIFF_PEAK_FLOPS pattern: off-TPU `memory_stats()` self-disables,
+# so deterministic planning needs the budget from the environment.
+HBM_BYTES_ENV = "FLAXDIFF_HBM_BYTES"
+
+
+def resolved_hbm_bytes(monitor: Optional["MemoryMonitor"] = None
+                       ) -> Optional[float]:
+    """The per-device HBM budget for plan pruning: the
+    FLAXDIFF_HBM_BYTES env override when set to a positive number,
+    else the min per-device `bytes_limit` from allocator stats, else
+    None (host-RSS fallback keys deliberately do NOT masquerade as
+    HBM — callers skip HBM pruning instead of pruning on a fiction)."""
+    raw = os.environ.get(HBM_BYTES_ENV)
+    if raw:
+        try:
+            val = float(raw)
+            if val > 0:
+                return val
+        except ValueError:
+            log.warning("ignoring malformed %s=%r", HBM_BYTES_ENV, raw)
+    stats = (monitor or MemoryMonitor()).sample()
+    limit = stats.get("memory/bytes_limit")
+    return float(limit) if limit else None
+
 
 class MemoryMonitor:
     """Bounded-cardinality memory gauge sampler (host-side, no device

@@ -220,16 +220,28 @@ class DiffusionTrainer:
                  tx: optax.GradientTransformation,
                  schedule: NoiseSchedule,
                  transform: PredictionTransform,
-                 mesh: Mesh,
+                 mesh: Optional[Mesh] = None,
                  config: TrainerConfig = TrainerConfig(),
                  policy: Optional[Policy] = None,
                  autoencoder: Optional[Any] = None,
                  null_cond: Optional[PyTree] = None,
                  checkpointer: Optional[Any] = None,
                  telemetry: Optional[Any] = None,
-                 elastic: Optional[Any] = None):
+                 elastic: Optional[Any] = None,
+                 plan: Optional[Any] = None,
+                 partition_rules: Optional[Sequence] = None):
         """apply_fn(params, x_t, t, cond) -> raw output;
         init_fn(key) -> params (closes over example input shapes).
+
+        `plan`: "auto" resolves mesh AND partition rules from the
+        auto-parallelism planner (`parallel/planner.resolve_plan` —
+        static search over the param tree, cached in
+        $FLAXDIFF_PLAN_CACHE, committed to the telemetry hub's program
+        registry), replacing the hand-written mesh/rule table; a
+        `PlanDecision` applies a previously-searched plan verbatim.
+        With a plan, `mesh` may be None. `partition_rules` pins an
+        explicit `match_partition_rules` table (the planner's probe
+        harness and tests use it; a resolved plan overrides it).
 
         `telemetry`: a telemetry.Telemetry hub; None falls back to the
         process-global hub at fit time (disabled by default, so
@@ -337,7 +349,22 @@ class DiffusionTrainer:
 
         key = jax.random.PRNGKey(config.seed)
         state_shapes = jax.eval_shape(create_state, key)
-        self.state_specs = fsdp_sharding_tree(state_shapes, mesh)
+
+        self.plan_decision = None
+        self._partition_rules = partition_rules
+        if plan is not None:
+            from ..parallel.planner import resolve_plan
+            decision = resolve_plan(plan, state_shapes.params,
+                                    telemetry=telemetry)
+            mesh = decision.build_mesh()
+            self._partition_rules = decision.rules
+            self.plan_decision = decision
+        if mesh is None:
+            raise ValueError("DiffusionTrainer needs a mesh or a plan")
+        self.mesh = mesh
+
+        self.state_specs = fsdp_sharding_tree(
+            state_shapes, mesh, rules=self._partition_rules)
         self.state_shardings = sharding_tree(self.state_specs, mesh)
 
         with mesh:
@@ -435,6 +462,10 @@ class DiffusionTrainer:
             lambda x: (jax.ShapeDtypeStruct(x.shape, x.dtype)
                        if isinstance(x, jax.Array) else x), self.state)
         self.mesh = new_mesh
+        # a searched plan is dead with the mesh it was searched for —
+        # the shrunken world re-infers (and can re-plan at next launch)
+        self._partition_rules = None
+        self.plan_decision = None
         self.state_specs = fsdp_sharding_tree(shapes, new_mesh)
         self.state_shardings = sharding_tree(self.state_specs, new_mesh)
         self._batch_axis = batch_spec(new_mesh)

@@ -84,13 +84,21 @@ _NEUTRAL = ("seed", "count", "n_requests", "rate_hz", "batch", "steps",
             "rounds", "requests", "completed", "incarnation", "epoch",
             "devices", "world", "num_", "resolution", "nfe", "secs",
             "budget", "attempts", "image_size", "flops", "slo_ms",
-            "schema_version")
+            "schema_version",
+            # planner decision bookkeeping (parallel/planner.py): how
+            # many candidates were enumerated/pruned/probed describes
+            # the SEARCH, not run quality — only the chosen plan's
+            # probe/predicted ms (the "_ms" rule) regress
+            "candidates", "pruned_", "probes", "cache_hit")
 # neutral checked on the FULL path (before the generic "bytes"-is-worse
 # heuristic): the static comm model (`collectives`,
 # `comm_bytes_by_axis/<axis>`) describes the PROGRAM, not the run — a
 # change means the program changed shape, which the lint comm budgets
 # gate; here it is reported informationally, never as a regression
-_NEUTRAL_PATH = ("comm_bytes", "collectives")
+_NEUTRAL_PATH = ("comm_bytes", "collectives",
+                 # a plan's HBM-fit estimate describes the CHOSEN plan
+                 # (a deliberate memory/comm tradeoff), not a leak
+                 "hbm_estimate")
 
 
 def direction(path: str) -> int:
@@ -278,6 +286,13 @@ def load_telemetry_dir(path: str) -> Dict[str, Any]:
                   if isinstance(row.get(k), (int, float))}
         if isinstance(row.get("comm_bytes_by_axis"), dict):
             fields["comm_bytes_by_axis"] = row["comm_bytes_by_axis"]
+        # planner decision rows (kind "plan"/"plan_infer") carry their
+        # search/decision numbers as plan_* fields — diffable like any
+        # other evidence (direction rules above)
+        for k, v in row.items():
+            if k.startswith("plan_") and isinstance(v, (int, float)) \
+                    and not isinstance(v, bool):
+                fields[k] = v
         programs[ident] = _flatten(fields)
     out = {"kind": "telemetry", "fingerprint": fp, "stages": stages}
     if programs:

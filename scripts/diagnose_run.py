@@ -42,7 +42,10 @@ from typing import Dict, List, Optional
 # v2: + device_profile (devprof.jsonl windows, ISSUE 19); programs
 #     rows now carry merged program_update annotations (measured MFU,
 #     roofline verdict)
-REPORT_SCHEMA_VERSION = 2
+# v3: + plan (auto-parallelism planner decisions, ISSUE 20 — registry
+#     rows of kind "plan"/"plan_infer" summarized: chosen plan,
+#     candidates considered/pruned/probed, predicted vs measured ms)
+REPORT_SCHEMA_VERSION = 3
 
 
 def _percentile(xs: List[float], q: float) -> float:
@@ -621,6 +624,76 @@ def programs_section(programs: List[Dict], lines: List[str]) -> None:
     lines.append("")
 
 
+PLAN_KINDS = ("plan", "plan_infer")
+
+
+def plan_rows(programs: List[Dict]) -> List[Dict]:
+    """Planner decision rows (parallel/planner.py commits them to the
+    program registry under kind "plan" — training — and "plan_infer" —
+    the serving engine's chips-per-request search), summarized for the
+    report: the chosen plan, the search accounting, and predicted vs
+    measured milliseconds."""
+    out = []
+    for p in programs:
+        if p.get("kind") not in PLAN_KINDS:
+            continue
+        out.append({
+            "kind": p.get("kind"),
+            "key": p.get("key"),
+            "chosen": p.get("plan_chosen") or p.get("plan"),
+            "table": p.get("plan_table"),
+            "axes": p.get("plan_axes"),
+            "candidates": p.get("plan_candidates"),
+            "pruned_unmatched": p.get("plan_pruned_unmatched"),
+            "pruned_hbm": p.get("plan_pruned_hbm"),
+            "pruned_comm": p.get("plan_pruned_comm"),
+            "probes": p.get("plan_probes"),
+            "cache_hit": p.get("plan_cache_hit"),
+            "predicted_ms": p.get("plan_predicted_ms"),
+            "probe_ms": p.get("plan_probe_ms"),
+            "hbm_estimate_bytes": p.get("plan_hbm_estimate_bytes"),
+            "hbm_budget_bytes": p.get("plan_hbm_budget_bytes"),
+            "comm_bytes_by_axis": p.get("comm_bytes_by_axis") or {},
+            "shortlist": p.get("plan_shortlist") or [],
+        })
+    return sorted(out, key=lambda r: (str(r["kind"]), str(r["key"])))
+
+
+def plan_section(programs: List[Dict], lines: List[str]) -> None:
+    """Auto-parallelism plan decisions: what the planner chose, how
+    much of the search it pruned statically, and whether the choice
+    was measured (probes) or cached."""
+    rows = plan_rows(programs)
+    if not rows:
+        return
+    lines.append(f"== Plan ({len(rows)} decision(s)) ==")
+    lines.append(f"{'kind':<11s} {'chosen':<34s} {'cand':>5s} "
+                 f"{'-unm':>5s} {'-hbm':>5s} {'-comm':>6s} "
+                 f"{'probes':>7s} {'pred ms':>9s} {'probe ms':>9s} "
+                 f"{'cache':>6s}")
+
+    def num(v, fmt="{:d}"):
+        return fmt.format(int(v)) if isinstance(v, (int, float)) else "-"
+
+    def ms(v):
+        return f"{v:.2f}" if isinstance(v, (int, float)) else "-"
+
+    for r in rows:
+        lines.append(
+            f"{str(r['kind']):<11s} {str(r['chosen'])[:34]:<34s} "
+            f"{num(r['candidates']):>5s} {num(r['pruned_unmatched']):>5s} "
+            f"{num(r['pruned_hbm']):>5s} {num(r['pruned_comm']):>6s} "
+            f"{num(r['probes']):>7s} {ms(r['predicted_ms']):>9s} "
+            f"{ms(r['probe_ms']):>9s} "
+            f"{('hit' if r['cache_hit'] else 'miss'):>6s}")
+        by_axis = r["comm_bytes_by_axis"]
+        if by_axis:
+            comm = " ".join(f"{a}={by_axis[a] / 1024.0:.1f}KiB"
+                            for a in sorted(by_axis))
+            lines.append(f"{'':<11s} comm/axis: {comm}")
+    lines.append("")
+
+
 def devprof_section(devrows: List[Dict], lines: List[str]) -> None:
     """Device-profile windows (telemetry/devprof.py): the op-family /
     module attribution of the LAST parsed window, plus the registry
@@ -877,6 +950,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                                                       0.0)))
                         if ok_traces else None)}
         doc["programs"] = programs
+        doc["plan"] = {"decisions": plan_rows(programs)}
         ok_rows = [r for r in devrows if r.get("status") == "ok"]
         doc["device_profile"] = {
             "windows": len(devrows),
@@ -900,6 +974,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     data_health_section(metrics, quarantines, breakers, skews, lines)
     reqtrace_section(reqtraces, lines)
     programs_section(programs, lines)
+    plan_section(programs, lines)
     devprof_section(devrows, lines)
     counters_section(metrics, lines)
     trace_path = os.path.join(directory, "trace.json")

@@ -535,17 +535,20 @@ def test_diagnose_json_schema_pinned_and_incident_sections(
 
     assert main([str(tmp_path), "--json"]) == 0
     doc = json.loads(capsys.readouterr().out)
-    assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 2
+    assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 3
     assert set(doc) == {"schema_version", "goodput", "steps",
                         "phase_rows", "step_wall_s", "pod_last",
                         "health", "elasticity", "frontdoor", "slo",
                         "incidents", "data_health", "request_traces",
-                        "programs", "device_profile"}
+                        "programs", "device_profile", "plan"}
     # no profile windows ran: the stanza is present but empty (the
     # key set is the contract, not conditional)
     assert doc["device_profile"] == {"windows": 0,
                                      "parse_failures": 0,
                                      "last": None}
+    # same contract for the planner stanza: present, empty without
+    # any committed plan decision
+    assert doc["plan"] == {"decisions": []}
     assert doc["slo"]["slo/attainment/t0"] == 0.5
     assert len(doc["incidents"]) == 1
     inc = doc["incidents"][0]

@@ -1,0 +1,475 @@
+"""Measurement-driven auto-parallelism planner (parallel/planner.py;
+ISSUE 20).
+
+The acceptance loop, all on the conftest 8-device virtual CPU mesh
+with a REAL tiny SimpleDiT param tree: enumerate >= 8 candidates,
+reject at least one on the HBM envelope and at least one on the comm
+ranking, probe the shortlist through an injected probe, never choose a
+plan statically worse than the hand-tuned data2 x fsdp2 x tensor2
+default, answer a warm-cache re-plan with ZERO probes, and land a
+byte-stable decision row in the program evidence registry that
+round-trips through `scripts/compare_runs.py` without spurious
+regressions.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flaxdiff_tpu.parallel import create_mesh
+from flaxdiff_tpu.parallel.planner import (AXIS_PIPE, CACHE_FILENAME,
+                                           CandidatePlan,
+                                           ParallelPlanner,
+                                           PlanDecision,
+                                           enumerate_candidates,
+                                           evaluate_candidate,
+                                           generate_rules, plan_cache_key,
+                                           resolve_plan, tree_signature)
+
+MIN_SIZE = 2 ** 8       # tiny test model; production floor is 64 KiB
+
+
+@pytest.fixture(scope="module")
+def dit_shapes():
+    """Real SimpleDiT param tree as shapes only (eval_shape — the
+    planner must work before anything is materialized, exactly like
+    the trainer's plan="auto" seam)."""
+    from flaxdiff_tpu.models.dit import SimpleDiT
+    model = SimpleDiT(output_channels=1, patch_size=2, emb_features=32,
+                      num_layers=2, num_heads=2, backend="xla")
+
+    def init():
+        return model.init(jax.random.PRNGKey(0),
+                          jnp.zeros((1, 16, 16, 1)),
+                          jnp.zeros((1,)), None)["params"]
+
+    return jax.eval_shape(init)
+
+
+def _total_bytes(tree):
+    return sum(int(np.prod(l.shape)) * l.dtype.itemsize
+               for l in jax.tree_util.tree_leaves(tree))
+
+
+def _planner(tmp_path=None, **kw):
+    kw.setdefault("min_size", MIN_SIZE)
+    return ParallelPlanner(
+        cache_dir=str(tmp_path) if tmp_path is not None else None, **kw)
+
+
+def _plan(planner, shapes, **kw):
+    kw.setdefault("batch_shape", (8, 16, 16, 1))
+    kw.setdefault("hbm_bytes", _total_bytes(shapes) * 3.0)
+    return planner.plan(shapes, **kw)
+
+
+# -- enumeration + static pruning ---------------------------------------------
+
+def test_enumerate_covers_factorizations_and_tables(dit_shapes):
+    cands = enumerate_candidates(
+        8, tree_paths=[p for p, _, _ in
+                       __import__("flaxdiff_tpu.parallel.planner",
+                                  fromlist=["_tree_leaves"])
+                       ._tree_leaves(dit_shapes)])
+    names = {c.name for c in cands}
+    # every divisor triple of 8 appears, on both rule tables
+    assert "data2xfsdp2xtensor2/generated" in names
+    assert "data2xfsdp2xtensor2/inferred" in names
+    assert "data8xfsdp1xtensor1/inferred" in names
+    assert "data1xfsdp8xtensor1/generated" in names
+    # the 2-block DiT admits a pipe=2 split
+    assert any(c.axes_dict.get(AXIS_PIPE) == 2 for c in cands)
+    assert len(cands) >= 8
+
+
+def test_plan_prunes_hbm_and_comm_and_beats_baseline(devices, dit_shapes):
+    """The headline acceptance: >= 8 candidates enumerated, >= 1
+    rejected by the HBM envelope, >= 1 ranked out below the shortlist,
+    zero unmatched-coverage leaks, and the chosen plan's static comm
+    bill is <= the hand-tuned data2 x fsdp2 x tensor2 baseline's."""
+    planner = _planner()
+    decision = _plan(planner, dit_shapes, devices=devices)
+    assert decision.candidates >= 8
+    assert decision.pruned_unmatched == 0
+    assert decision.pruned_hbm >= 1
+    assert decision.pruned_comm >= 1
+    assert decision.probes == 0          # no probe_fn installed
+    baseline = evaluate_candidate(
+        CandidatePlan(axes=(("data", 2), ("fsdp", 2), ("tensor", 2)),
+                      table="inferred"),
+        dit_shapes, devices, min_size=MIN_SIZE,
+        batch_shape=(8, 16, 16, 1))
+    assert baseline is not None and baseline.unmatched == 0
+    assert decision.comm_bytes <= baseline.comm_bytes
+    # the decision is executable: mesh forms over the same devices and
+    # the generated table (when chosen) covers the tree
+    mesh = decision.build_mesh(devices)
+    assert int(np.prod(mesh.devices.shape)) == len(devices)
+
+
+def test_hbm_budget_prunes_everything_raises(devices, dit_shapes):
+    planner = _planner()
+    with pytest.raises(ValueError, match="no candidate plan fits"):
+        _plan(planner, dit_shapes, devices=devices, hbm_bytes=1.0)
+
+
+def test_tight_budget_prefers_more_sharding(devices, dit_shapes):
+    """Shrinking the budget must never pick a LESS-sharded plan: the
+    fully replicated data8 layout dies first."""
+    planner = _planner()
+    total = _total_bytes(dit_shapes)
+    roomy = _plan(planner, dit_shapes, devices=devices,
+                  hbm_bytes=total * 100.0)
+    tight = _plan(_planner(), dit_shapes, devices=devices,
+                  hbm_bytes=total * 3.0)
+    assert tight.pruned_hbm >= roomy.pruned_hbm
+    assert tight.hbm_estimate_bytes <= total * 3.0
+
+
+# -- measured probes ----------------------------------------------------------
+
+def test_probe_fn_runs_on_shortlist_and_picks_measured_min(devices,
+                                                           dit_shapes):
+    seen = []
+
+    def probe(ev):
+        seen.append(ev.name)
+        # every later probe measures strictly faster, so the LAST
+        # shortlist entry (the statically worst survivor) must win —
+        # measurement beats the static ranking
+        return float(-len(seen))
+
+    planner = _planner(probe_fn=probe, top_k=3)
+    decision = _plan(planner, dit_shapes, devices=devices)
+    assert planner.probe_count == len(seen) == decision.probes
+    assert 1 < decision.probes <= 3
+    assert set(decision.shortlist) == set(seen)
+    assert decision.name == seen[-1] == decision.shortlist[-1]
+    assert decision.probe_ms == float(-len(seen))
+
+
+def test_failing_probe_keeps_static_rank(devices, dit_shapes):
+    def probe(ev):
+        raise RuntimeError("probe harness down")
+
+    planner = _planner(probe_fn=probe)
+    decision = _plan(planner, dit_shapes, devices=devices)
+    assert planner.probe_count >= 2       # probes were attempted
+    assert decision.probe_ms is None      # none survived
+    # falls back to the static comm argmin
+    assert decision.name == decision.shortlist[0]
+
+
+# -- plan cache ---------------------------------------------------------------
+
+def test_warm_cache_zero_probes_same_plan(tmp_path, devices, dit_shapes):
+    calls = []
+    cold = _planner(tmp_path, probe_fn=lambda ev: calls.append(ev.name)
+                    or 1.0)
+    first = _plan(cold, dit_shapes, devices=devices)
+    assert not first.cache_hit and cold.probe_count == len(calls) > 1
+    assert os.path.exists(tmp_path / CACHE_FILENAME)
+
+    # a FRESH planner over the same cache dir: same decision, and the
+    # counting probe proves the search never ran again
+    warm_calls = []
+    warm = _planner(tmp_path, probe_fn=lambda ev:
+                    warm_calls.append(ev.name) or 1.0)
+    second = _plan(warm, dit_shapes, devices=devices)
+    assert second.cache_hit is True
+    assert warm.probe_count == 0 and warm_calls == []
+    assert second.name == first.name
+    assert second.axes == first.axes
+    assert second.comm_bytes == first.comm_bytes
+
+
+def test_cache_key_separates_shapes_and_topology(dit_shapes):
+    sig = tree_signature(dit_shapes)
+    assert sig != tree_signature({"other": jnp.zeros((4, 4))})
+    k8 = plan_cache_key(sig, 8, {"platform": "cpu", "device_kind": "cpu"})
+    k4 = plan_cache_key(sig, 4, {"platform": "cpu", "device_kind": "cpu"})
+    ktpu = plan_cache_key(sig, 8, {"platform": "tpu",
+                                   "device_kind": "TPU v4"})
+    assert len({k8, k4, ktpu}) == 3
+    assert sig in k8
+
+
+def test_decision_json_round_trip_carries_rules(devices, dit_shapes):
+    planner = _planner()
+    decision = _plan(planner, dit_shapes, devices=devices)
+    back = PlanDecision.from_json(json.loads(json.dumps(
+        decision.to_json())))
+    assert back.name == decision.name
+    assert back.axes == decision.axes
+    assert back.comm_bytes_by_axis == decision.comm_bytes_by_axis
+    if decision.rules is not None:
+        assert back.rules is not None
+        assert [(p, tuple(s)) for p, s in back.rules] == \
+            [(p, tuple(s)) for p, s in decision.rules]
+        # the round-tripped rules still cover the tree
+        from flaxdiff_tpu.parallel.partition import partition_coverage
+        mesh = back.build_mesh(devices)
+        cov = partition_coverage(dit_shapes, mesh, rules=back.rules,
+                                 min_size=MIN_SIZE)
+        assert all(a.source == "rule" for a in cov)
+
+
+# -- HBM budget resolution (telemetry/memory.py) ------------------------------
+
+def test_resolved_hbm_bytes_env_override(monkeypatch):
+    from flaxdiff_tpu.telemetry.memory import (HBM_BYTES_ENV,
+                                               resolved_hbm_bytes)
+    monkeypatch.setenv(HBM_BYTES_ENV, str(16 * 2 ** 30))
+    assert resolved_hbm_bytes() == float(16 * 2 ** 30)
+    # malformed / non-positive values fall through to the monitor path
+    class FakeMon:
+        def sample(self):
+            return {"memory/bytes_limit": 123.0}
+    monkeypatch.setenv(HBM_BYTES_ENV, "not-a-number")
+    assert resolved_hbm_bytes(FakeMon()) == 123.0
+    monkeypatch.setenv(HBM_BYTES_ENV, "-5")
+    assert resolved_hbm_bytes(FakeMon()) == 123.0
+    monkeypatch.delenv(HBM_BYTES_ENV)
+    class EmptyMon:
+        def sample(self):
+            return {}
+    assert resolved_hbm_bytes(EmptyMon()) is None
+
+
+# -- evidence registry --------------------------------------------------------
+
+def test_commit_lands_byte_stable_registry_row(tmp_path, devices,
+                                               dit_shapes):
+    """One `record` row (kind "plan") + the measured fields through the
+    `annotate` write-back; committing the same decision twice re-uses
+    the row, and the merged view is stable."""
+    from flaxdiff_tpu.telemetry.programs import (ProgramRegistry,
+                                                 read_registry)
+    path = tmp_path / "programs.jsonl"
+    reg = ProgramRegistry(path=str(path), deep=False)
+    planner = _planner(probe_fn=lambda ev: 7.5)
+    decision = _plan(planner, dit_shapes, devices=devices)
+    planner.commit(reg, decision)
+
+    [row] = [r for r in read_registry(str(path)) if r["kind"] == "plan"]
+    assert row["plan"] == decision.name
+    assert row["plan_candidates"] == decision.candidates
+    assert row["plan_pruned_hbm"] == decision.pruned_hbm
+    assert row["plan_pruned_comm"] == decision.pruned_comm
+    assert row["plan_chosen"] == decision.name       # annotation merged
+    assert row["plan_probes"] == decision.probes
+    assert row["plan_probe_ms"] == 7.5
+    assert row["comm_bytes_by_axis"] == decision.comm_bytes_by_axis
+
+    planner.commit(reg, decision)        # idempotent re-commit
+    rows = [r for r in read_registry(str(path)) if r["kind"] == "plan"]
+    assert len(rows) == 1
+    assert json.dumps(rows[0], sort_keys=True) == \
+        json.dumps(row, sort_keys=True)
+
+
+def test_plan_rows_round_trip_through_compare_runs(tmp_path, devices,
+                                                   dit_shapes, capsys):
+    """Acceptance: two runs carrying the SAME committed plan compare
+    clean (exit 0, byte-stable --json), and the plan_* fields appear in
+    the diff with search bookkeeping informational."""
+    from flaxdiff_tpu import telemetry as T
+    from scripts.compare_runs import main
+
+    dirs = []
+    for name in ("a", "b"):
+        d = tmp_path / name
+        tele = T.Telemetry.create(str(d))
+        planner = _planner(probe_fn=lambda ev: 7.5)
+        decision = _plan(planner, dit_shapes, devices=devices)
+        planner.commit(tele.programs, decision)
+        tele.close()
+        dirs.append(str(d))
+
+    assert main([*dirs, "--json"]) == 0
+    first = capsys.readouterr().out
+    assert main([*dirs, "--json"]) == 0
+    assert capsys.readouterr().out == first
+    doc = json.loads(first)
+    assert doc["ok"] is True
+    rows = {r["metric"]: r for r in doc["programs"]["rows"]}
+    assert rows["plan_candidates"]["direction"] == "info"
+    assert rows["plan_probe_ms"]["regressed"] is False
+    assert rows["plan_probe_ms"]["direction"] == "up_is_worse"
+
+
+def test_diagnose_run_renders_plan_section(tmp_path, devices,
+                                           dit_shapes, capsys):
+    from flaxdiff_tpu import telemetry as T
+    from scripts.diagnose_run import main
+
+    d = tmp_path / "run"
+    tele = T.Telemetry.create(str(d))
+    planner = _planner()
+    decision = _plan(planner, dit_shapes, devices=devices)
+    planner.commit(tele.programs, decision)
+    tele.close()
+
+    assert main([str(d), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    [row] = doc["plan"]["decisions"]
+    assert row["chosen"] == decision.name
+    assert row["candidates"] == decision.candidates
+    assert row["cache_hit"] == 0
+    assert main([str(d)]) == 0
+    text = capsys.readouterr().out
+    assert "== Plan (1 decision(s)) ==" in text
+    assert decision.name in text
+
+
+# -- consumer seams -----------------------------------------------------------
+
+def _tiny_trainer_parts():
+    import flax.linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, t, cond=None):
+            h = nn.Conv(16, (3, 3))(x)
+            h = nn.Dense(512)(nn.Dense(512)(h[..., :1]))  # plannable MLP
+            return nn.Conv(x.shape[-1], (3, 3))(jnp.tanh(x + 0 * h))
+
+    model = Tiny()
+
+    def apply_fn(params, x, t, cond):
+        return model.apply({"params": params}, x, t, None)
+
+    def init_fn(key):
+        return model.init(key, jnp.zeros((1, 8, 8, 1)),
+                          jnp.zeros((1,)))["params"]
+
+    return apply_fn, init_fn
+
+
+def test_trainer_plan_auto_builds_mesh_and_commits(tmp_path, monkeypatch):
+    import optax
+
+    from flaxdiff_tpu import telemetry as T
+    from flaxdiff_tpu.parallel.planner import CACHE_ENV
+    from flaxdiff_tpu.predictors import EpsilonPredictionTransform
+    from flaxdiff_tpu.schedulers import CosineNoiseSchedule
+    from flaxdiff_tpu.telemetry.memory import HBM_BYTES_ENV
+    from flaxdiff_tpu.trainer import DiffusionTrainer, TrainerConfig
+
+    monkeypatch.setenv(CACHE_ENV, str(tmp_path / "cache"))
+    monkeypatch.setenv(HBM_BYTES_ENV, str(64 * 2 ** 20))
+    apply_fn, init_fn = _tiny_trainer_parts()
+    tele = T.Telemetry.create(str(tmp_path / "run"))
+    trainer = DiffusionTrainer(
+        apply_fn=apply_fn, init_fn=init_fn, tx=optax.adam(1e-3),
+        schedule=CosineNoiseSchedule(timesteps=100),
+        transform=EpsilonPredictionTransform(), plan="auto",
+        telemetry=tele,
+        config=TrainerConfig(normalize=False, log_every=50))
+    assert trainer.plan_decision is not None
+    decision = trainer.plan_decision
+    # pipeline plans are excluded: the trainer's step is plain jit
+    assert AXIS_PIPE not in decision.axes_dict
+    assert set(trainer.mesh.axis_names) == set(decision.axes_dict)
+    # the plan actually trains: two steps through the real fit path
+    rng = np.random.default_rng(0)
+    batch = {"sample": rng.normal(size=(8, 8, 8, 1)).astype(np.float32)}
+
+    def data():
+        while True:
+            yield batch
+
+    history = trainer.fit(data(), total_steps=2)
+    assert history["loss"] and np.isfinite(history["loss"][-1])
+
+    # the searched plan reached the evidence registry
+    tele.close()
+    rows = [r for r in T.read_registry(
+        str(tmp_path / "run" / "programs.jsonl"))
+        if r.get("kind") == "plan"]
+    assert len(rows) == 1 and rows[0]["plan"] == decision.name
+
+
+def test_trainer_requires_mesh_or_plan():
+    import optax
+
+    from flaxdiff_tpu.predictors import EpsilonPredictionTransform
+    from flaxdiff_tpu.schedulers import CosineNoiseSchedule
+    from flaxdiff_tpu.trainer import DiffusionTrainer, TrainerConfig
+
+    apply_fn, init_fn = _tiny_trainer_parts()
+    with pytest.raises(ValueError, match="mesh or a plan"):
+        DiffusionTrainer(
+            apply_fn=apply_fn, init_fn=init_fn, tx=optax.adam(1e-3),
+            schedule=CosineNoiseSchedule(timesteps=100),
+            transform=EpsilonPredictionTransform(),
+            config=TrainerConfig(normalize=False))
+
+
+def test_engine_plan_parallelism_commits_plan_infer(tmp_path, dit_shapes):
+    """The serving seam: params-only multipliers, kind "plan_infer",
+    and the chips-per-request answer derived from the chosen axes."""
+    from flaxdiff_tpu import telemetry as T
+    from flaxdiff_tpu.serving import SamplerProgramEngine
+
+    class FakePipe:
+        params = None
+
+    eng = SamplerProgramEngine.__new__(SamplerProgramEngine)
+    eng.pipeline = FakePipe()
+    eng.telemetry = T.Telemetry.create(str(tmp_path / "run"))
+    decision = eng.plan_parallelism(
+        param_shapes=dit_shapes, batch_shape=(8, 16, 16, 1),
+        min_size=MIN_SIZE,
+        hbm_bytes=_total_bytes(dit_shapes) * 2.0)
+    assert AXIS_PIPE not in decision.axes_dict
+    assert decision.chips_per_request >= 1
+    prod = 1
+    for _, s in decision.axes:
+        prod *= s
+    assert prod == len(jax.devices())
+    eng.telemetry.close()
+    rows = [r for r in T.read_registry(
+        str(tmp_path / "run" / "programs.jsonl"))
+        if r.get("kind") == "plan_infer"]
+    assert len(rows) == 1 and rows[0]["plan_chosen"] == decision.name
+
+
+def test_resolve_plan_passthrough_and_rejects_garbage(devices,
+                                                      dit_shapes):
+    planner = _planner()
+    decision = _plan(planner, dit_shapes, devices=devices)
+    same = resolve_plan(decision, dit_shapes, devices=devices)
+    assert same is decision
+    with pytest.raises(ValueError, match="plan must be"):
+        resolve_plan("fastest", dit_shapes, devices=devices)
+
+
+def test_achieved_bandwidth_median_of_devprof_rows():
+    from flaxdiff_tpu.parallel.planner import achieved_bandwidth
+    rows = [{"comm_achieved_bytes_per_s": 1e9},
+            {"comm_achieved_bytes_per_s": 3e9},
+            {"comm_achieved_bytes_per_s": 2e9},
+            {"comm_achieved_bytes_per_s": 0.0},   # ignored
+            {"status": "ok"}]                      # ignored
+    assert achieved_bandwidth(rows) == 2e9
+    assert achieved_bandwidth([]) is None
+
+
+def test_generated_rules_zero_unmatched_on_train_state_paths(devices,
+                                                             dit_shapes):
+    """The table the planner commits must keep covering the tree once
+    the trainer wraps it (params/ema/optimizer copies) — the suffix
+    anchor contract."""
+    mesh = create_mesh(axes={"fsdp": 8}, devices=devices)
+    rules = generate_rules(dit_shapes, mesh, min_size=MIN_SIZE)
+    from flaxdiff_tpu.parallel.partition import partition_coverage
+    wrapped = {"params": dit_shapes, "ema_params": dit_shapes,
+               "mu": dit_shapes}
+    cov = partition_coverage(wrapped, mesh, rules=rules,
+                             min_size=MIN_SIZE)
+    assert all(a.source == "rule" for a in cov)

@@ -190,7 +190,11 @@ def test_repo_lint_clean_unified(capsys):
     from flaxdiff_tpu.analysis.budgets import ALLOWLIST
     for pinned in ("flaxdiff_tpu/telemetry/slo.py",
                    "flaxdiff_tpu/telemetry/flightrec.py",
-                   "flaxdiff_tpu/telemetry/devprof.py"):
+                   "flaxdiff_tpu/telemetry/devprof.py",
+                   # ISSUE 20: the planner is a static search — its one
+                   # sync lives behind the blessed _block_until_ready
+                   # seam for injected probe fns, never inline
+                   "flaxdiff_tpu/parallel/planner.py"):
         assert ALLOWLIST["host-sync"][pinned] == 0, pinned
 
 
@@ -302,6 +306,22 @@ def test_compare_runs_comm_model_is_neutral(tmp_path, capsys):
     worse = _telemetry_fixture(tmp_path, "worse", 30.0, 100.0,
                                comm_bytes=999999)
     assert main([a, worse]) == 1
+
+
+def test_compare_runs_plan_field_directions():
+    """ISSUE 20 contract: planner decision fields diff with the right
+    signs — search bookkeeping (candidate/prune/probe counts, cache
+    hits, the HBM estimate/budget of the CHOSEN plan) is informational,
+    while the chosen plan's measured/predicted milliseconds regress
+    like any latency."""
+    from scripts.compare_runs import direction
+    for path in ("plan_probe_ms", "plan_predicted_ms"):
+        assert direction(path) == 1, path
+    for path in ("plan_candidates", "plan_pruned_unmatched",
+                 "plan_pruned_hbm", "plan_pruned_comm", "plan_probes",
+                 "plan_cache_hit", "plan_hbm_estimate_bytes",
+                 "plan_hbm_budget_bytes", "comm_bytes_by_axis/fsdp"):
+        assert direction(path) == 0, path
 
 
 def test_compare_runs_fingerprint_mismatch(tmp_path, capsys):
